@@ -1,0 +1,143 @@
+"""Model math tests: parameter-count parity with the reference's closed forms,
+init statistics, forward shapes, scan-vs-loop equivalence, remat gradient parity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vitax.config import Config
+from vitax.models.vit import VisionTransformer, build_model, count_params, expected_param_count
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        image_size=32, patch_size=16, embed_dim=64, num_heads=2, num_blocks=2,
+        mlp_ratio=4.0, num_classes=10, batch_size=8, dtype="float32",
+    )
+    base.update(kw)
+    return Config(**base).validate()
+
+
+def init_params(cfg, rng=0):
+    model = build_model(cfg)
+    x = jnp.zeros((2, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    return model, model.init(jax.random.key(rng), x, True)
+
+
+def test_param_count_closed_form_10b():
+    """The flagship config must hit the reference's exact 10,077,917,160
+    (SURVEY.md section 6; reference README.md:3 '10 billion')."""
+    cfg = Config()  # defaults = the 10B config
+    assert expected_param_count(cfg) == 10_077_917_160
+
+
+def test_param_count_tiny_matches_closed_form():
+    cfg = tiny_cfg()
+    _, params = init_params(cfg)
+    assert count_params(params) == expected_param_count(cfg)
+
+
+def test_param_count_vit_tiny_16():
+    """BASELINE.json config 1: ViT-Tiny/16 (192 dim, 3 heads, 12 blocks)."""
+    cfg = Config(image_size=224, patch_size=16, embed_dim=192, num_heads=3,
+                 num_blocks=12, num_classes=1000, dtype="float32").validate()
+    _, params = init_params(cfg)
+    n = count_params(params)
+    assert n == expected_param_count(cfg)
+    # ViT-Tiny/16 is ~5.7M params
+    assert 5_000_000 < n < 6_500_000
+
+
+def test_forward_shape_and_dtype():
+    cfg = tiny_cfg()
+    model, params = init_params(cfg)
+    x = jnp.ones((4, 32, 32, 3), jnp.float32)
+    logits = model.apply(params, x, True)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_scan_and_unrolled_blocks_agree():
+    """lax.scan over stacked params must compute the same function as an
+    unrolled per-block loop (same per-layer weights)."""
+    cfg_scan = tiny_cfg(scan_blocks=True, grad_ckpt=False)
+    cfg_loop = tiny_cfg(scan_blocks=False, grad_ckpt=False)
+    model_s, params_s = init_params(cfg_scan)
+    model_l = build_model(cfg_loop)
+
+    # Rebuild loop params from the stacked scan params.
+    stacked = params_s["params"]["blocks"]
+    loop_params = {k: v for k, v in params_s["params"].items() if k != "blocks"}
+    for i in range(cfg_loop.num_blocks):
+        loop_params[f"blocks_{i}"] = jax.tree.map(lambda a: a[i], stacked)
+
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3), jnp.float32)
+    out_s = model_s.apply(params_s, x, True)
+    out_l = model_l.apply({"params": loop_params}, x, True)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_l), rtol=1e-5, atol=1e-5)
+
+
+def test_remat_matches_no_remat():
+    """Activation checkpointing must not change forward or gradient values."""
+    cfg_a = tiny_cfg(grad_ckpt=True)
+    cfg_b = tiny_cfg(grad_ckpt=False)
+    model_a, params = init_params(cfg_a)
+    model_b = build_model(cfg_b)
+    x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3), jnp.float32)
+
+    def loss_fn(model):
+        def f(p):
+            return jnp.sum(model.apply(p, x, True) ** 2)
+        return f
+
+    la, ga = jax.value_and_grad(loss_fn(model_a))(params)
+    lb, gb = jax.value_and_grad(loss_fn(model_b))(params)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_init_statistics():
+    """trunc-normal(0.02) weights, zero biases, LN ones/zeros
+    (timm _init_vit_weights semantics, reference run_vit_training.py:125-152)."""
+    cfg = tiny_cfg(embed_dim=128, num_blocks=2)
+    _, params = init_params(cfg)
+    p = params["params"]
+
+    qkv_kernel = p["blocks"]["attn"]["qkv"]["kernel"]
+    std = float(jnp.std(qkv_kernel))
+    assert 0.015 < std < 0.025, f"qkv kernel std {std} not ~0.02"
+    # truncated at 2 sigma (bound leaves headroom for rescaling jax versions)
+    assert float(jnp.max(jnp.abs(qkv_kernel))) < 0.046
+
+    assert float(jnp.max(jnp.abs(p["blocks"]["attn"]["qkv"]["bias"]))) == 0.0
+    np.testing.assert_array_equal(np.asarray(p["norm"]["scale"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(p["norm"]["bias"]), 0.0)
+
+    pos = p["pos_embed"]
+    assert pos.shape == (1, cfg.num_patches, cfg.embed_dim)
+    std = float(jnp.std(pos))
+    assert 0.015 < std < 0.025
+
+
+def test_dropout_active_in_train_mode():
+    cfg = tiny_cfg(pos_dropout=0.5, mlp_dropout=0.5)
+    model, params = init_params(cfg)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    out1 = model.apply(params, x, False, rngs={"dropout": jax.random.key(1)})
+    out2 = model.apply(params, x, False, rngs={"dropout": jax.random.key(2)})
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+    # deterministic mode is rng-independent
+    out3 = model.apply(params, x, True)
+    out4 = model.apply(params, x, True)
+    np.testing.assert_array_equal(np.asarray(out3), np.asarray(out4))
+
+
+def test_mean_pool_not_cls():
+    """No CLS token: sequence length stays (image/patch)^2 and the head sees the
+    mean-pooled sequence (reference run_vit_training.py:127,159-161)."""
+    cfg = tiny_cfg()
+    _, params = init_params(cfg)
+    assert params["params"]["pos_embed"].shape[1] == (32 // 16) ** 2

@@ -1,0 +1,62 @@
+"""Schedule parity with reference utils.py:11-21 at the boundary points
+{0, mid-warmup, warmup, mid-decay, max} (SURVEY.md section 4)."""
+
+import math
+
+import numpy as np
+
+from vitax.train.schedule import warmup_cosine_schedule
+
+
+def reference_ratio(step, warmup, max_iter):
+    """Literal reimplementation of reference utils.py:12-19 for comparison."""
+    if step < warmup:
+        return step * 1.0 / warmup
+    where = (step - warmup) * 1.0 / (max_iter - warmup)
+    return 0.5 * (1 + math.cos(math.pi * where))
+
+
+def test_schedule_boundary_values():
+    base_lr, warmup, max_iter = 1e-3, 10_000, 375_300
+    sched = warmup_cosine_schedule(base_lr, warmup, max_iter)
+    assert float(sched(0)) == 0.0  # lr is 0 at step 0
+    np.testing.assert_allclose(float(sched(warmup // 2)), base_lr * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(warmup)), base_lr, rtol=1e-6)
+    mid = (warmup + max_iter) // 2
+    np.testing.assert_allclose(float(sched(mid)), base_lr * reference_ratio(mid, warmup, max_iter), rtol=1e-5)
+    np.testing.assert_allclose(float(sched(max_iter)), 0.0, atol=1e-9)
+
+
+def test_schedule_matches_reference_everywhere():
+    base_lr, warmup, max_iter = 3e-4, 100, 1000
+    sched = warmup_cosine_schedule(base_lr, warmup, max_iter)
+    for step in range(0, 1001, 7):
+        want = base_lr * reference_ratio(step, warmup, max_iter)
+        np.testing.assert_allclose(float(sched(step)), want, rtol=1e-5, atol=1e-10,
+                                   err_msg=f"step {step}")
+
+
+def test_schedule_zero_warmup():
+    """With warmup 0 the reference never enters the warmup branch: pure cosine,
+    full lr at step 0."""
+    base_lr, max_iter = 1e-3, 1000
+    sched = warmup_cosine_schedule(base_lr, 0, max_iter)
+    np.testing.assert_allclose(float(sched(0)), base_lr, rtol=1e-6)
+    for step in (0, 1, 500, 999, 1000):
+        want = base_lr * reference_ratio(step, 0, max_iter)
+        np.testing.assert_allclose(float(sched(step)), want, rtol=1e-5, atol=1e-10)
+
+
+def test_smoothed_value_parity():
+    """SmoothedValue windowed stats match the reference implementation semantics
+    (reference utils.py:60-102)."""
+    from vitax.utils.metrics import SmoothedValue
+
+    sv = SmoothedValue(window_size=3)
+    for v, b in [(1.0, 1), (2.0, 1), (3.0, 2), (4.0, 1)]:
+        sv.update(v, b)
+    # window holds (2.0,1),(3.0,2),(4.0,1): avg = (2+6+4)/4
+    np.testing.assert_allclose(sv.avg, 3.0)
+    np.testing.assert_allclose(sv.median, 3.0)
+    np.testing.assert_allclose(sv.global_avg, (1 + 2 + 6 + 4) / 5)
+    assert sv.get_latest() == 4.0

@@ -1,0 +1,154 @@
+"""Configuration: CLI flags and typed config.
+
+Keeps the reference's exact 26-flag surface (names, defaults, and the ``--no_X`` /
+store_false idiom) as a compatibility contract (reference run_vit_training.py:327-363),
+plus vitax-specific extensions that default to reference-equivalent behavior.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class Config:
+    """Typed training configuration.
+
+    The first group mirrors the reference CLI one-to-one
+    (reference run_vit_training.py:329-361). The ``vitax:`` group adds
+    TPU-native knobs (mesh shape, dtype, kernels) with conservative defaults.
+    """
+
+    # --- data / io (reference :329-337) ---
+    data_dir: str = "/datasets/imagenet-1k"
+    fake_data: bool = False
+    num_workers: int = 4
+    ckpt_dir: str = "/tmp/vit_fsdp"
+    resume_epoch: int = 0
+    ckpt_epoch_interval: int = 10
+    test_epoch_interval: int = 10
+    log_step_interval: int = 20
+
+    # --- model shape (reference :339-348; defaults = the 10.078B ViT) ---
+    image_size: int = 224
+    patch_size: int = 14
+    embed_dim: int = 5120
+    num_heads: int = 32
+    num_blocks: int = 32
+    mlp_ratio: float = 4.0
+    pos_dropout: float = 0.0
+    att_dropout: float = 0.0
+    mlp_dropout: float = 0.0
+    num_classes: int = 1000
+
+    # --- optimization (reference :351-356) ---
+    batch_size: int = 1024
+    num_epochs: int = 300
+    lr: float = 1e-3
+    weight_decay: float = 0.1
+    clip_grad_norm: float = 1.0
+    warmup_steps: int = 10000
+
+    # --- parallelism toggles (reference :357-361) ---
+    grad_ckpt: bool = True              # --no_grad_ckpt clears
+    reshard_after_forward: bool = True  # --no_reshard_after_forward clears (ZeRO-3 -> ZeRO-2)
+    flatten_parameters: bool = False    # accepted for parity; a no-op under GSPMD (see parallel/sharding.py)
+    run_without_fsdp: bool = False      # pure data-parallel baseline (params replicated)
+    shard_on_cpu: bool = False          # host-side init + per-shard device_put (10B+ init w/o HBM OOM)
+
+    # --- vitax: TPU-native extensions (all default to reference-equivalent behavior) ---
+    seed: int = 0
+    dtype: str = "bfloat16"             # compute dtype; params/opt state stay float32
+    use_flash_attention: bool = True    # Pallas flash-attention kernel on TPU (jnp fallback elsewhere)
+    # Mesh: (dp, fsdp, tp, sp). -1 on fsdp means "all remaining devices".
+    dp_size: int = 1
+    fsdp_size: int = -1
+    tp_size: int = 1
+    sp_size: int = 1
+    scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
+    remat_policy: str = "none_saveable" # none_saveable | dots_saveable | nothing (only used if grad_ckpt)
+    profile_dir: str = ""               # if set, capture a jax.profiler trace of a few steps
+    debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
+    log_memory: bool = True             # include HBM stats in step log
+    steps_per_epoch: int = 0            # override (0 = derive from dataset length // batch_size)
+    max_steps: int = 0                  # hard stop after N optimizer steps (0 = no limit; for smoke/bench)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def mlp_hidden_dim(self) -> int:
+        return int(self.embed_dim * self.mlp_ratio)
+
+    def validate(self) -> "Config":
+        assert self.image_size % self.patch_size == 0, (
+            f"image_size {self.image_size} not divisible by patch_size {self.patch_size}")
+        assert self.embed_dim % self.num_heads == 0, (
+            f"embed_dim {self.embed_dim} not divisible by num_heads {self.num_heads}")
+        return self
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Argparse surface: reference flags verbatim + `vitax:`-group extensions."""
+    parser = argparse.ArgumentParser(description="vitax: TPU-native large-ViT FSDP training")
+
+    # Reference flag surface (run_vit_training.py:329-361) — names and defaults are a contract.
+    parser.add_argument("--data_dir", type=str, default="/datasets/imagenet-1k")
+    parser.add_argument("--fake_data", action="store_true", dest="fake_data")
+    parser.add_argument("--num_workers", type=int, default=4)
+    parser.add_argument("--ckpt_dir", type=str, default="/tmp/vit_fsdp")
+    parser.add_argument("--resume_epoch", type=int, default=0)
+    parser.add_argument("--ckpt_epoch_interval", type=int, default=10)
+    parser.add_argument("--test_epoch_interval", type=int, default=10)
+    parser.add_argument("--log_step_interval", type=int, default=20)
+
+    parser.add_argument("--image_size", type=int, default=224)
+    parser.add_argument("--patch_size", type=int, default=14)
+    parser.add_argument("--embed_dim", type=int, default=5120)
+    parser.add_argument("--num_heads", type=int, default=32)
+    parser.add_argument("--num_blocks", type=int, default=32)
+    parser.add_argument("--mlp_ratio", type=float, default=4.0)
+    parser.add_argument("--pos_dropout", type=float, default=0.0)
+    parser.add_argument("--att_dropout", type=float, default=0.0)
+    parser.add_argument("--mlp_dropout", type=float, default=0.0)
+    parser.add_argument("--num_classes", type=int, default=1000)
+
+    parser.add_argument("--batch_size", type=int, default=1024)
+    parser.add_argument("--num_epochs", type=int, default=300)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--weight_decay", type=float, default=0.1)
+    parser.add_argument("--clip_grad_norm", type=float, default=1.0)
+    parser.add_argument("--warmup_steps", type=int, default=10000)
+    parser.add_argument("--no_grad_ckpt", action="store_false", dest="grad_ckpt")
+    parser.add_argument("--no_reshard_after_forward", action="store_false", dest="reshard_after_forward")
+    parser.add_argument("--flatten_parameters", action="store_true", dest="flatten_parameters")
+    parser.add_argument("--run_without_fsdp", action="store_true", dest="run_without_fsdp")
+    parser.add_argument("--shard_on_cpu", action="store_true", dest="shard_on_cpu")
+
+    # vitax extensions
+    ext = parser.add_argument_group("vitax")
+    ext.add_argument("--seed", type=int, default=0)
+    ext.add_argument("--dtype", type=str, default="bfloat16", choices=["bfloat16", "float32"])
+    ext.add_argument("--no_flash_attention", action="store_false", dest="use_flash_attention")
+    ext.add_argument("--dp_size", type=int, default=1)
+    ext.add_argument("--fsdp_size", type=int, default=-1)
+    ext.add_argument("--tp_size", type=int, default=1)
+    ext.add_argument("--sp_size", type=int, default=1)
+    ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
+    ext.add_argument("--remat_policy", type=str, default="none_saveable",
+                     choices=["none_saveable", "dots_saveable"])
+    ext.add_argument("--profile_dir", type=str, default="")
+    ext.add_argument("--debug_nans", action="store_true", dest="debug_nans")
+    ext.add_argument("--no_log_memory", action="store_false", dest="log_memory")
+    ext.add_argument("--steps_per_epoch", type=int, default=0)
+    ext.add_argument("--max_steps", type=int, default=0)
+    return parser
+
+
+def parse_config(argv: Optional[Tuple[str, ...]] = None) -> Config:
+    ns = build_parser().parse_args(argv)
+    cfg = Config(**{f.name: getattr(ns, f.name) for f in dataclasses.fields(Config)})
+    return cfg.validate()

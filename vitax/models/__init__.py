@@ -1,0 +1,9 @@
+from vitax.models.vit import (  # noqa: F401
+    Attention,
+    Block,
+    Mlp,
+    PatchEmbed,
+    VisionTransformer,
+    build_model,
+    count_params,
+)

@@ -1,0 +1,341 @@
+"""Vision Transformer in Flax, designed TPU-first.
+
+Capability parity with the reference model stack (reference run_vit_training.py:99-162
+composing timm 0.4.12 PatchEmbed/Block), re-designed for XLA:
+
+- Blocks run under ``jax.lax.scan`` over stacked layer parameters (`nn.scan`):
+  one traced/compiled block body regardless of depth, vs the reference's 32
+  individually-wrapped modules (compile time + HLO size win).
+- Activation checkpointing is `jax.remat` composed *inside* the scan, matching the
+  reference's checkpoint_module-inside-FSDP order (reference run_vit_training.py:143-145).
+- Computation in bfloat16 (MXU-native), parameters in float32.
+- The attention inner product is pluggable: a Pallas flash-attention kernel on TPU
+  (vitax.ops.attention) or the dense jnp reference path.
+
+Architecture parity notes (verified against the reference by param-count closed form,
+10,077,917,160 at default flags — see tests/test_model.py):
+- conv patchify (patch_size stride/kernel) -> (B, N, D)           [timm PatchEmbed]
+- learned pos_embed, shape (1, N, D), trunc-normal std 0.02; NO CLS token
+  (reference run_vit_training.py:127-128)
+- pre-norm blocks: LN -> MHA (fused qkv, qkv_bias=True) -> residual;
+  LN -> MLP(GELU, hidden=dim*mlp_ratio) -> residual                [timm Block]
+- block LayerNorm eps = 1e-5 (timm Block default when constructed directly,
+  as the reference does at run_vit_training.py:134-141); final LayerNorm eps = 1e-6
+  (reference run_vit_training.py:151)
+- mean-pool over sequence (arXiv:2106.04560), then Linear head
+  (reference run_vit_training.py:155-162)
+- init: trunc-normal(std=0.02) weights, zero biases, LN ones/zeros (timm
+  _init_vit_weights semantics, reference run_vit_training.py:125,142,152,128)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vitax.config import Config
+
+Array = jax.Array
+Dtype = Any
+
+# timm _init_vit_weights: trunc_normal_(std=.02) on Linear weights, zero bias.
+# jax's truncated_normal truncates at +/-2 sigma without rescaling the stddev —
+# the same behavior as torch.nn.init.trunc_normal_ (measured std ~0.0176 for 0.02).
+default_init = nn.initializers.truncated_normal(stddev=0.02)
+
+
+class PatchEmbed(nn.Module):
+    """Conv patchify: (B, H, W, 3) -> (B, N, D). timm PatchEmbed equivalent
+    (reference run_vit_training.py:124)."""
+
+    patch_size: int
+    embed_dim: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        p = self.patch_size
+        x = nn.Conv(
+            features=self.embed_dim,
+            kernel_size=(p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=default_init,
+            bias_init=nn.initializers.zeros,
+            name="proj",
+        )(x)
+        b, h, w, d = x.shape
+        return x.reshape(b, h * w, d)
+
+
+class Attention(nn.Module):
+    """Multi-head self-attention with fused qkv projection (timm Attention parity:
+    qkv_bias=True per reference run_vit_training.py:138).
+
+    `attention_impl`, when provided, computes the (softmax(QK^T/sqrt(d))V) core —
+    e.g. the Pallas flash-attention kernel — and receives (q, k, v) shaped
+    (B, N, H, Dh). The default is the dense jnp path.
+    """
+
+    num_heads: int
+    qkv_bias: bool = True
+    att_dropout: float = 0.0
+    proj_dropout: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    attention_impl: Optional[Callable[[Array, Array, Array], Array]] = None
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        b, n, d = x.shape
+        head_dim = d // self.num_heads
+
+        qkv = nn.Dense(
+            3 * d,
+            use_bias=self.qkv_bias,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=default_init,
+            bias_init=nn.initializers.zeros,
+            name="qkv",
+        )(x)
+        qkv = qkv.reshape(b, n, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # each (B, N, H, Dh)
+
+        use_kernel = (
+            self.attention_impl is not None
+            and (self.att_dropout == 0.0 or deterministic)
+        )
+        if use_kernel:
+            out = self.attention_impl(q, k, v)  # (B, N, H, Dh)
+        else:
+            scale = head_dim ** -0.5
+            # accumulate logits in float32 on the MXU for stable softmax
+            attn = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+            attn = jax.nn.softmax(attn, axis=-1).astype(self.dtype)
+            attn = nn.Dropout(rate=self.att_dropout)(attn, deterministic=deterministic)
+            out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+
+        out = out.reshape(b, n, d)
+        out = nn.Dense(
+            d,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=default_init,
+            bias_init=nn.initializers.zeros,
+            name="proj",
+        )(out)
+        out = nn.Dropout(rate=self.proj_dropout)(out, deterministic=deterministic)
+        return out
+
+
+class Mlp(nn.Module):
+    """timm Mlp parity: Dense(hidden) -> GELU(exact) -> drop -> Dense(d) -> drop."""
+
+    hidden_dim: int
+    out_dim: int
+    dropout: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        x = nn.Dense(
+            self.hidden_dim,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=default_init,
+            bias_init=nn.initializers.zeros,
+            name="fc1",
+        )(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.Dropout(rate=self.dropout)(x, deterministic=deterministic)
+        x = nn.Dense(
+            self.out_dim,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=default_init,
+            bias_init=nn.initializers.zeros,
+            name="fc2",
+        )(x)
+        x = nn.Dropout(rate=self.dropout)(x, deterministic=deterministic)
+        return x
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block (timm Block parity, reference run_vit_training.py:134-141)."""
+
+    num_heads: int
+    mlp_ratio: float = 4.0
+    att_dropout: float = 0.0
+    mlp_dropout: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    attention_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        d = x.shape[-1]
+        # timm Block default norm_layer is nn.LayerNorm with eps=1e-5 when
+        # constructed directly (as the reference does).
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32, name="norm1")(x)
+        y = Attention(
+            num_heads=self.num_heads,
+            att_dropout=self.att_dropout,
+            proj_dropout=self.mlp_dropout,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="attn",
+        )(y, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32, name="norm2")(x)
+        y = Mlp(
+            hidden_dim=int(d * self.mlp_ratio),
+            out_dim=d,
+            dropout=self.mlp_dropout,
+            dtype=self.dtype,
+            name="mlp",
+        )(y, deterministic=deterministic)
+        return x + y
+
+
+_REMAT_POLICIES = {
+    # Save nothing per block — recompute everything in backward. This is the
+    # reference's checkpoint_module semantics (torch activation checkpointing).
+    "none_saveable": None,
+    # Save MXU outputs (matmul results), recompute elementwise — often the best
+    # HBM/FLOP tradeoff on TPU.
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+}
+
+
+class VisionTransformer(nn.Module):
+    """The full ViT (reference FSDPViTModel parity, run_vit_training.py:99-162),
+    with blocks run as a scanned (stacked-parameter) stack."""
+
+    image_size: int = 224
+    patch_size: int = 14
+    embed_dim: int = 5120
+    num_heads: int = 32
+    num_blocks: int = 32
+    mlp_ratio: float = 4.0
+    pos_dropout: float = 0.0
+    att_dropout: float = 0.0
+    mlp_dropout: float = 0.0
+    num_classes: int = 1000
+    dtype: Dtype = jnp.bfloat16
+    scan_blocks: bool = True
+    grad_ckpt: bool = True
+    remat_policy: str = "none_saveable"
+    attention_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, images: Array, deterministic: bool = True) -> Array:
+        """images: (B, H, W, 3) float -> logits (B, num_classes) float32."""
+        num_patches = (self.image_size // self.patch_size) ** 2
+
+        x = PatchEmbed(
+            patch_size=self.patch_size, embed_dim=self.embed_dim, dtype=self.dtype,
+            name="patch_embed",
+        )(images.astype(self.dtype))
+
+        pos_embed = self.param(
+            "pos_embed", default_init, (1, num_patches, self.embed_dim), jnp.float32)
+        x = x + pos_embed.astype(self.dtype)
+        x = nn.Dropout(rate=self.pos_dropout)(x, deterministic=deterministic)
+
+        block_kwargs = dict(
+            num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio,
+            att_dropout=self.att_dropout,
+            mlp_dropout=self.mlp_dropout,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+        )
+
+        def body(block: Block, carry: Array, det: bool):
+            return block(carry, det), None
+
+        if self.grad_ckpt:
+            policy = _REMAT_POLICIES[self.remat_policy]  # KeyError on unknown names
+            # remat composed inside the scan body — per-block recompute, the
+            # reference's checkpoint_module-then-FSDP order (run_vit_training.py:145).
+            body = nn.remat(body, policy=policy, prevent_cse=False, static_argnums=(2,))
+
+        if self.scan_blocks:
+            # One compiled block body via lax.scan; params stacked with a leading
+            # (num_blocks,) axis — uniform FSDP sharding and O(1) compile in depth.
+            scan = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.num_blocks,
+                in_axes=(nn.broadcast,),
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )
+            x, _ = scan(Block(name="blocks", **block_kwargs), x, deterministic)
+        else:
+            for i in range(self.num_blocks):
+                x, _ = body(Block(name=f"blocks_{i}", **block_kwargs), x, deterministic)
+
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32, name="norm")(x)
+        x = jnp.mean(x, axis=1)  # mean-pool over sequence (arXiv:2106.04560)
+        logits = nn.Dense(
+            self.num_classes,
+            dtype=jnp.float32,  # head + loss in float32
+            param_dtype=jnp.float32,
+            kernel_init=default_init,
+            bias_init=nn.initializers.zeros,
+            name="head",
+        )(x)
+        return logits
+
+
+def build_model(cfg: Config, attention_impl: Optional[Callable] = None) -> VisionTransformer:
+    """Construct the model from config (reference build_fsdp_vit_model parity,
+    run_vit_training.py:165-200 — minus the wrapping, which in vitax is a sharding
+    declaration applied at jit boundaries, not a module transform)."""
+    return VisionTransformer(
+        image_size=cfg.image_size,
+        patch_size=cfg.patch_size,
+        embed_dim=cfg.embed_dim,
+        num_heads=cfg.num_heads,
+        num_blocks=cfg.num_blocks,
+        mlp_ratio=cfg.mlp_ratio,
+        pos_dropout=cfg.pos_dropout,
+        att_dropout=cfg.att_dropout,
+        mlp_dropout=cfg.mlp_dropout,
+        num_classes=cfg.num_classes,
+        dtype=jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32,
+        scan_blocks=cfg.scan_blocks,
+        grad_ckpt=cfg.grad_ckpt,
+        remat_policy=cfg.remat_policy,
+        attention_impl=attention_impl,
+    )
+
+
+def count_params(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def expected_param_count(cfg: Config) -> int:
+    """Closed-form parameter count, matching the reference's 10,077,917,160 at
+    default flags (SURVEY.md section 6)."""
+    d = cfg.embed_dim
+    h = cfg.mlp_hidden_dim
+    n = cfg.num_patches
+    per_block = (
+        d * 3 * d + 3 * d      # qkv
+        + d * d + d            # proj
+        + d * h + h            # fc1
+        + h * d + d            # fc2
+        + 2 * (2 * d)          # two LayerNorms
+    )
+    patch = 3 * cfg.patch_size * cfg.patch_size * d + d
+    pos = n * d
+    final_ln = 2 * d
+    head = d * cfg.num_classes + cfg.num_classes
+    return per_block * cfg.num_blocks + patch + pos + final_ln + head

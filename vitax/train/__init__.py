@@ -1,0 +1,1 @@
+from vitax.train.schedule import warmup_cosine_schedule  # noqa: F401

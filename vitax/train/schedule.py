@@ -1,0 +1,33 @@
+"""LR schedule: linear warmup then half-cosine decay to 0.
+
+Exact parity with the reference's LambdaLR multiplier (reference utils.py:11-21):
+  step < warmup:  ratio = step / warmup          (so lr == 0 at step 0)
+  else:           where = (step - warmup) / (max - warmup)
+                  ratio = 0.5 * (1 + cos(pi * where))
+
+Implemented as a pure step -> lr function (optax-style schedule), evaluated inside
+the jitted train step — no host-side scheduler object to keep in sync.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def warmup_cosine_schedule(base_lr: float, warmup_iteration: int, max_iteration: int):
+    """Returns schedule(step) -> lr. Matches reference utils.py:12-19 including
+    lr == 0 at step 0 and cosine reaching 0 at max_iteration; with
+    warmup_iteration == 0 the warmup branch is never taken (pure cosine from
+    step 0), exactly like the reference's `step < warmup` test."""
+    warmup = int(warmup_iteration)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm_ratio = step / max(warmup, 1)  # divisor unused when warmup == 0
+        denom = max(max_iteration - warmup, 1)
+        where = (step - warmup) / denom
+        cos_ratio = 0.5 * (1.0 + jnp.cos(jnp.pi * where))
+        ratio = jnp.where(step < warmup, warm_ratio, cos_ratio)
+        return base_lr * ratio
+
+    return schedule

@@ -1,0 +1,2 @@
+from vitax.utils.metrics import SmoothedValue  # noqa: F401
+from vitax.utils.logging import master_print, memory_summary  # noqa: F401

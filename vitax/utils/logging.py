@@ -1,0 +1,47 @@
+"""Rank-0 logging + device memory telemetry.
+
+Replaces xm.master_print (reference run_vit_training.py, 15 call sites) and
+xm.get_memory_info (reference run_vit_training.py:212).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+
+def is_master() -> bool:
+    return jax.process_index() == 0
+
+
+def master_print(*args, **kwargs) -> None:
+    """Print on global process 0 only (xm.master_print parity)."""
+    if is_master():
+        kwargs.setdefault("flush", True)
+        print(*args, **kwargs)
+
+
+def memory_summary(device=None) -> str:
+    """Human-readable HBM usage for the step log (xm.get_memory_info parity).
+
+    Uses PJRT memory_stats when the backend provides them (TPU does); degrades
+    gracefully on CPU where stats are unavailable.
+    """
+    device = device or jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return "mem: n/a"
+    in_use = stats.get("bytes_in_use", 0)
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    peak = stats.get("peak_bytes_in_use")
+    gib = 1024 ** 3
+    parts = [f"used={in_use / gib:.2f}GiB"]
+    if peak:
+        parts.append(f"peak={peak / gib:.2f}GiB")
+    if limit:
+        parts.append(f"limit={limit / gib:.2f}GiB")
+    return "mem: " + " ".join(parts)

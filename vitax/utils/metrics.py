@@ -1,0 +1,53 @@
+"""Windowed smoothed meters (reference SmoothedValue parity, utils.py:60-102)."""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class SmoothedValue:
+    """Track a series of values; expose median / windowed batch-weighted avg /
+    global avg / latest. Capability parity with reference utils.py:60-102
+    (itself adapted from facebookresearch/mmf), without the numpy dependency."""
+
+    def __init__(self, window_size: int = 20):
+        self.window_size = window_size
+        self.reset()
+
+    def reset(self) -> None:
+        self.deque = deque(maxlen=self.window_size)            # value * batch_size
+        self.averaged_value_deque = deque(maxlen=self.window_size)  # raw values
+        self.batch_sizes = deque(maxlen=self.window_size)
+        self.total_samples = 0
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, value: float, batch_size: int = 1) -> None:
+        value = float(value)
+        self.deque.append(value * batch_size)
+        self.averaged_value_deque.append(value)
+        self.batch_sizes.append(batch_size)
+        self.count += 1
+        self.total_samples += batch_size
+        self.total += value * batch_size
+
+    @property
+    def median(self) -> float:
+        vals = sorted(self.averaged_value_deque)
+        n = len(vals)
+        if n == 0:
+            return float("nan")
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+    @property
+    def avg(self) -> float:
+        denom = sum(self.batch_sizes)
+        return sum(self.deque) / denom if denom else float("nan")
+
+    @property
+    def global_avg(self) -> float:
+        return self.total / self.total_samples if self.total_samples else float("nan")
+
+    def get_latest(self) -> float:
+        return self.averaged_value_deque[-1]
