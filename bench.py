@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""vitax benchmark: images/sec/chip + MFU for the training step.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Default config is ViT-L/14 (BASELINE.json config 3 shape) sized for one chip;
+--preset tiny|l14|10b selects others. FLOP accounting: matmul FLOPs
+(patchify + qkv/proj/mlp/head) plus attention score/value einsums, x3 for
+fwd+bwd (the standard 6ND convention); remat recompute is NOT counted as
+useful work (true MFU).
+"""
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak TFLOP/s per chip by TPU generation (public figures)
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0, "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0, "v6 lite": 918.0,
+    "cpu": 1.0,
+}
+
+
+def detect_peak_tflops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197.0  # conservative default
+
+
+def model_flops_per_image(cfg) -> float:
+    """Useful matmul FLOPs per image, fwd+bwd (3x forward)."""
+    d, L = cfg.embed_dim, cfg.num_blocks
+    n = cfg.num_patches
+    h = cfg.mlp_hidden_dim
+    per_token_block = 2 * (3 * d * d + d * d + d * h + h * d)  # qkv, proj, fc1, fc2
+    attn_block = 2 * 2 * n * n * d                             # QK^T and AV
+    fwd = L * (per_token_block * n + attn_block)
+    fwd += 2 * n * (3 * cfg.patch_size ** 2) * d               # patchify conv
+    fwd += 2 * d * cfg.num_classes                             # head
+    return 3.0 * fwd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="l14", choices=["tiny", "l14", "10b"])
+    p.add_argument("--batch_size", type=int, default=0)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=8)
+    args = p.parse_args()
+
+    from vitax.config import Config
+    from vitax.models import build_model
+    from vitax.parallel.mesh import build_mesh, batch_pspec
+    from vitax.train.state import build_optimizer, make_train_state
+    from vitax.train.step import make_train_step
+    from jax.sharding import NamedSharding
+
+    n_dev = jax.device_count()
+    presets = {
+        "tiny": dict(image_size=224, patch_size=16, embed_dim=192, num_heads=3,
+                     num_blocks=12, batch_size=64 * n_dev),
+        "l14": dict(image_size=224, patch_size=14, embed_dim=1024, num_heads=16,
+                    num_blocks=24, batch_size=32 * n_dev),
+        "10b": dict(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
+                    num_blocks=32, batch_size=8 * n_dev),
+    }
+    kw = presets[args.preset]
+    if args.batch_size:
+        kw["batch_size"] = args.batch_size
+    cfg = Config(num_classes=1000, warmup_steps=0, **kw).validate()
+
+    mesh = build_mesh(cfg)
+    from vitax.ops.attention import make_attention_impl
+    model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh))
+    tx, _ = build_optimizer(cfg, max_iteration=10_000)
+    state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
+    step_fn = make_train_step(cfg, model, tx, mesh, sspecs)
+
+    sh = NamedSharding(mesh, batch_pspec())
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jax.device_put(jnp.asarray(
+            rng.normal(size=(cfg.batch_size, cfg.image_size, cfg.image_size, 3)),
+            jnp.float32), sh),
+        "label": jax.device_put(jnp.asarray(
+            rng.integers(0, cfg.num_classes, size=(cfg.batch_size,)), jnp.int32), sh),
+    }
+    rng_key = jax.random.key(1)
+
+    # NOTE: sync via device_get, not block_until_ready — some PJRT transports
+    # (axon tunnel) return immediately from block_until_ready; fetching the
+    # value is the reliable fence.
+    for _ in range(max(args.warmup, 1)):  # >=1: compile before the timed loop
+        state, metrics = step_fn(state, batch, rng_key)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step_fn(state, batch, rng_key)
+    final_loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+
+    step_time = dt / args.steps
+    images_per_sec = cfg.batch_size / step_time
+    images_per_sec_chip = images_per_sec / n_dev
+    flops_per_image = model_flops_per_image(cfg)
+    mfu = (images_per_sec * flops_per_image) / (detect_peak_tflops() * 1e12 * n_dev)
+
+    baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BASELINE_MEASURED.json")
+    vs_baseline = 1.0
+    if os.path.exists(baseline_file):
+        with open(baseline_file) as f:
+            base = json.load(f).get(args.preset, {}).get("images_per_sec_chip")
+        if base:
+            vs_baseline = images_per_sec_chip / base
+
+    result = {
+        "metric": f"images/sec/chip (ViT-{args.preset}, train step, "
+                  f"{jax.devices()[0].device_kind}, mfu={mfu:.3f}, "
+                  f"step_time={step_time * 1e3:.1f}ms)",
+        "value": round(images_per_sec_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

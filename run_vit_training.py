@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""vitax training entry point — CLI-compatible with the reference's
+run_vit_training.py (same 26 flags, same defaults; reference :327-364).
+
+Launch (single host; each pod host runs the same command — see README):
+    python3 run_vit_training.py --fake_data ...
+"""
+
+from vitax.config import parse_config
+from vitax.train.loop import train
+
+
+def main(argv=None):
+    cfg = parse_config(argv)
+    train(cfg)
+
+
+if __name__ == "__main__":
+    main()

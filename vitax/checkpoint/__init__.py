@@ -1,0 +1,6 @@
+from vitax.checkpoint.orbax_io import (  # noqa: F401
+    epoch_ckpt_path,
+    latest_epoch,
+    restore_state,
+    save_state,
+)

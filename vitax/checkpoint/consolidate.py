@@ -1,0 +1,60 @@
+"""Offline checkpoint consolidation: sharded epoch checkpoint -> one .npz file.
+
+Parity with `python3 -m torch_xla.distributed.fsdp.consolidate_sharded_ckpts`
+(cited at reference utils.py:27-29): produces a single-file, framework-neutral
+export of the full (unsharded) parameters for serving/analysis.
+
+Unlike the reference's tool, no shard metadata is needed — Orbax checkpoints are
+already topology-independent; this tool simply restores on host and flattens.
+
+Usage:
+    python -m vitax.checkpoint.consolidate --ckpt_dir /path --epoch 10 --out full.npz
+    python -m vitax.checkpoint.consolidate ... --params_only
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from vitax.checkpoint.orbax_io import epoch_ckpt_path
+
+
+def _flatten(tree, prefix=""):
+    import jax
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def consolidate(ckpt_dir: str, epoch: int, out: str, params_only: bool = True) -> dict:
+    import orbax.checkpoint as ocp
+
+    path = epoch_ckpt_path(ckpt_dir, epoch)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(path)  # host restore: full numpy arrays
+    tree = state["params"] if params_only and "params" in state else state
+    flat = _flatten(tree)
+    np.savez(out, **flat)
+    total = sum(v.size for v in flat.values())
+    print(f"consolidated {len(flat)} arrays ({total:,} elements) from {path} -> {out}")
+    return flat
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt_dir", type=str, required=True)
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--out", type=str, required=True)
+    p.add_argument("--full_state", action="store_false", dest="params_only",
+                   help="include optimizer state and step, not just params")
+    args = p.parse_args(argv)
+    consolidate(args.ckpt_dir, args.epoch, args.out, args.params_only)
+
+
+if __name__ == "__main__":
+    main()

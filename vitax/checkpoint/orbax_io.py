@@ -1,0 +1,72 @@
+"""Sharded checkpointing via Orbax.
+
+Capability parity with the reference's per-rank ckpt scheme (reference
+utils.py:24-43; SURVEY.md section 3.3), improved the TPU-native way:
+
+- Every host writes only its own parameter/optimizer shards in parallel
+  (parity with master_only=False per-rank save, reference run_vit_training.py:299),
+  into ONE logical checkpoint directory per epoch — not per-rank files keyed by
+  local ordinal (the reference's naming collides on shared filesystems; see
+  SURVEY.md section 2.1 'subtle behavior').
+- Restore is topology-independent: Orbax reshards on load, so a checkpoint
+  written on a v5p-256 restores on a v5p-128 (the reference needs an offline
+  consolidation pass to change topology, utils.py:27-29).
+- The LR schedule needs no state: it is a pure function of the restored `step`
+  (reference saves lr_scheduler.state_dict, utils.py:31).
+
+Single-file consolidation (consolidate_sharded_ckpts parity) lives in
+vitax/checkpoint/consolidate.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from vitax.utils.logging import master_print
+
+PyTree = Any
+
+_EPOCH_RE = re.compile(r"^epoch_(\d+)$")
+
+
+def epoch_ckpt_path(ckpt_dir: str, epoch: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
+
+
+def latest_epoch(ckpt_dir: str) -> Optional[int]:
+    """Highest epoch with a complete checkpoint in ckpt_dir, or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    epochs = []
+    for name in os.listdir(ckpt_dir):
+        m = _EPOCH_RE.match(name)
+        if m and not name.endswith(".tmp"):
+            epochs.append(int(m.group(1)))
+    return max(epochs) if epochs else None
+
+
+def save_state(ckpt_dir: str, epoch: int, state: PyTree) -> str:
+    """Save the train state for `epoch`; all hosts write their shards in
+    parallel (reference save_ckpt with master_only=False, utils.py:24-33)."""
+    path = epoch_ckpt_path(ckpt_dir, epoch)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+    master_print(f"checkpoint saved to {path}")
+    return path
+
+
+def restore_state(ckpt_dir: str, epoch: int, abstract_state: PyTree) -> PyTree:
+    """Restore into the given abstract state (ShapeDtypeStructs carrying target
+    shardings) — resharding across topologies as needed (reference load_ckpt,
+    utils.py:37-43, without the same-topology restriction)."""
+    path = epoch_ckpt_path(ckpt_dir, epoch)
+    assert os.path.exists(path), f"checkpoint not found: {path}"
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(path, abstract_state)
+    master_print(f"resumed from checkpoint {path}")
+    return state

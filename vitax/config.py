@@ -74,6 +74,7 @@ class Config:
     log_memory: bool = True             # include HBM stats in step log
     steps_per_epoch: int = 0            # override (0 = derive from dataset length // batch_size)
     max_steps: int = 0                  # hard stop after N optimizer steps (0 = no limit; for smoke/bench)
+    eval_max_batches: int = 0           # cap val batches per eval (0 = full split, reference behavior)
 
     @property
     def num_patches(self) -> int:
@@ -145,6 +146,7 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--no_log_memory", action="store_false", dest="log_memory")
     ext.add_argument("--steps_per_epoch", type=int, default=0)
     ext.add_argument("--max_steps", type=int, default=0)
+    ext.add_argument("--eval_max_batches", type=int, default=0)
     return parser
 
 

@@ -1,0 +1,2 @@
+from vitax.data.fake import FakeImageNetDataset  # noqa: F401
+from vitax.data.loader import ShardedLoader, build_datasets  # noqa: F401

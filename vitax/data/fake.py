@@ -1,0 +1,33 @@
+"""Fake ImageNet dataset (reference FakeImageNetDataset parity, utils.py:46-55).
+
+Zero-filled images, label 0, real ImageNet split lengths (1,281,167 train /
+50,000 val — reference run_vit_training.py:59-60). This is the fixture that
+validates the whole distributed graph — compile, collectives, memory — without
+any data on disk (reference README.md:76; SURVEY.md section 4).
+
+Images are NHWC (TPU-native layout; XLA convolutions want channels-last),
+vs the reference's CHW torch tensors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRAIN_SPLIT_LEN = 1_281_167
+VAL_SPLIT_LEN = 50_000
+
+
+class FakeImageNetDataset:
+    def __init__(self, image_size: int, length: int):
+        self.image_size = image_size
+        self.length = length
+
+    def __getitem__(self, idx: int):
+        s = self.image_size
+        return np.zeros((s, s, 3), np.float32), 0
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __repr__(self) -> str:
+        return f"FakeImageNetDataset(image_size={self.image_size}, length={self.length})"

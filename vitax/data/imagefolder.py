@@ -1,0 +1,59 @@
+"""ImageFolder dataset: class-per-subdirectory layout -> (image, label).
+
+Parity with torchvision.datasets.ImageFolder as the reference uses it
+(reference run_vit_training.py:47,56; layout contract in reference
+README.md:46-74): classes are the sorted subdirectory names of the split root,
+samples are the images inside them, labels are the class indices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+from PIL import Image
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+class ImageFolderDataset:
+    def __init__(self, root: str, transform: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        self.classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+        if not self.classes:
+            raise FileNotFoundError(f"no class subdirectories under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+
+        self.samples: List[Tuple[str, int]] = []
+        for cls in self.classes:
+            cls_dir = os.path.join(root, cls)
+            for dirpath, _, filenames in sorted(os.walk(cls_dir)):
+                for fname in sorted(filenames):
+                    if fname.lower().endswith(IMG_EXTENSIONS):
+                        self.samples.append(
+                            (os.path.join(dirpath, fname), self.class_to_idx[cls]))
+        if not self.samples:
+            raise FileNotFoundError(f"no images found under {root}")
+
+    def set_epoch(self, epoch: int) -> None:
+        if self.transform is not None and hasattr(self.transform, "set_epoch"):
+            self.transform.set_epoch(epoch)
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
+        path, label = self.samples[idx]
+        with Image.open(path) as img:
+            img = img.convert("RGB")
+            if self.transform is not None:
+                return self.transform(img, index=idx), label
+            return np.asarray(img, np.float32) / 255.0, label
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __repr__(self) -> str:
+        return (f"ImageFolderDataset(root={self.root!r}, classes={len(self.classes)}, "
+                f"samples={len(self.samples)})")

@@ -1,0 +1,129 @@
+"""Image transforms: numerical parity with the reference's torchvision stacks
+(reference run_vit_training.py:39-55), implemented on PIL + numpy.
+
+Train: RandomResizedCrop(size, scale=(0.08,1.0), ratio=(3/4,4/3), bicubic)
+       + RandomHorizontalFlip(0.5) + ToTensor + Normalize(ImageNet mean/std)
+Val:   Resize(size*256//224, bicubic) + CenterCrop(size) + ToTensor + Normalize
+
+Output is HWC float32 (TPU-native channels-last), not CHW.
+
+Augmentation randomness is derived from (seed, epoch, index) SeedSequences —
+thread-safe (the loader's worker pool calls into this concurrently) and
+reproducible, varying per epoch like torchvision's global-RNG behavior.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import numpy as np
+from PIL import Image
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+BICUBIC = Image.Resampling.BICUBIC
+
+
+def _to_normalized_array(img: Image.Image) -> np.ndarray:
+    arr = np.asarray(img, np.float32) / 255.0  # ToTensor parity (scale to [0,1])
+    return (arr - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def random_resized_crop(img: Image.Image, size: int, rng: np.random.Generator,
+                        scale: Tuple[float, float] = (0.08, 1.0),
+                        ratio: Tuple[float, float] = (3 / 4, 4 / 3)) -> Image.Image:
+    """torchvision RandomResizedCrop.get_params algorithm: 10 attempts at a
+    random area/aspect crop, then center-crop fallback with clamped ratio."""
+    width, height = img.size
+    area = width * height
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+        w = int(round(math.sqrt(target_area * aspect)))
+        h = int(round(math.sqrt(target_area / aspect)))
+        if 0 < w <= width and 0 < h <= height:
+            top = int(rng.integers(0, height - h + 1))
+            left = int(rng.integers(0, width - w + 1))
+            return img.resize((size, size), BICUBIC,
+                              box=(left, top, left + w, top + h))
+
+    # fallback: center crop at the closest valid ratio
+    in_ratio = width / height
+    if in_ratio < ratio[0]:
+        w, h = width, int(round(width / ratio[0]))
+    elif in_ratio > ratio[1]:
+        h, w = height, int(round(height * ratio[1]))
+    else:
+        w, h = width, height
+    left, top = (width - w) // 2, (height - h) // 2
+    return img.resize((size, size), BICUBIC, box=(left, top, left + w, top + h))
+
+
+def center_crop(img: Image.Image, size: int) -> Image.Image:
+    """torchvision CenterCrop parity (pads with zeros if the image is smaller)."""
+    width, height = img.size
+    if width < size or height < size:
+        padded = Image.new("RGB", (max(width, size), max(height, size)))
+        padded.paste(img, ((padded.width - width) // 2, (padded.height - height) // 2))
+        img, (width, height) = padded, padded.size
+    left, top = (width - size) // 2, (height - size) // 2
+    return img.crop((left, top, left + size, top + size))
+
+
+def resize_shorter(img: Image.Image, size: int) -> Image.Image:
+    """torchvision Resize(int) parity: scale the SHORTER side to `size`."""
+    width, height = img.size
+    if width <= height:
+        new_w, new_h = size, max(1, int(round(size * height / width)))
+    else:
+        new_h, new_w = size, max(1, int(round(size * width / height)))
+    return img.resize((new_w, new_h), BICUBIC)
+
+
+class TrainTransform:
+    """Reference train stack (run_vit_training.py:39-46)."""
+
+    def __init__(self, image_size: int, seed: int = 0):
+        self.image_size = image_size
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __call__(self, img: Image.Image, index: int = 0) -> np.ndarray:
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [self.seed, self.epoch, index]))
+        img = random_resized_crop(img, self.image_size, rng)
+        if rng.random() < 0.5:
+            img = img.transpose(Image.Transpose.FLIP_LEFT_RIGHT)
+        return _to_normalized_array(img)
+
+
+class ValTransform:
+    """Reference val stack (run_vit_training.py:48-55): resize shorter side to
+    size*256//224, center crop."""
+
+    def __init__(self, image_size: int):
+        self.image_size = image_size
+        self.resize_to = (image_size * 256) // 224
+
+    def set_epoch(self, epoch: int) -> None:
+        pass
+
+    def __call__(self, img: Image.Image, index: int = 0) -> np.ndarray:
+        img = resize_shorter(img, self.resize_to)
+        img = center_crop(img, self.image_size)
+        return _to_normalized_array(img)
+
+
+def train_transform(image_size: int, seed: int = 0) -> TrainTransform:
+    return TrainTransform(image_size, seed)
+
+
+def val_transform(image_size: int) -> ValTransform:
+    return ValTransform(image_size)

@@ -1,0 +1,62 @@
+"""Multi-host runtime: initialization, barriers, host-level reductions.
+
+TPU-native replacement for the reference's process/cluster layer (SURVEY.md
+sections 2.4, L1/L2):
+- xmp.spawn per-core processes (reference run_vit_training.py:364)  ->  ONE
+  process per host; jit spans all local devices; nothing to fork.
+- XRT mesh service control plane (xm.rendezvous at :224,230,241,252;
+  xm.mesh_reduce at :205,315)  ->  JAX coordination service
+  (jax.distributed.initialize) + multihost_utils collective barriers.
+- Data-plane collectives stay inside the compiled step (GSPMD over ICI).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+_initialized = False
+
+
+def maybe_initialize() -> None:
+    """Initialize the JAX distributed coordination service when running
+    multi-host (TPU pod metadata autodetects coordinator/rank). Single-host
+    (and CPU test) runs skip it — jit still spans all local devices.
+
+    Replaces the reference's xla_dist + per-core xmp.spawn bring-up
+    (reference README.md:99-101, run_vit_training.py:364).
+    """
+    global _initialized
+    if _initialized:
+        return
+    # Multi-host only: TPU pods expose worker topology via env/metadata.
+    in_pod = (
+        int(os.environ.get("TPU_WORKER_COUNT", "1")) > 1
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if in_pod:
+        jax.distributed.initialize()
+    _initialized = True
+
+
+def barrier(tag: str) -> None:
+    """Named cross-host barrier (xm.rendezvous parity, reference
+    run_vit_training.py:224,230,241,252). No-op single-host."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+def host_all_sum(value: Any):
+    """CPU-side cross-host sum of a Python scalar (xm.mesh_reduce parity,
+    reference run_vit_training.py:205,315-316). Prefer in-graph reductions —
+    this exists for host-only quantities."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+    import numpy as np
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    return gathered.sum()

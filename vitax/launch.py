@@ -1,0 +1,100 @@
+"""Pod launcher: run the training command on every TPU-VM worker.
+
+Parity with `torch_xla.distributed.xla_dist` (reference README.md:99-118;
+SURVEY.md section 3.5) built on the same mechanism the reference's own install
+step uses — `gcloud compute tpus tpu-vm ssh --worker=all` (reference
+README.md:29-31). JAX autodetects pod topology from TPU metadata, so the same
+command runs unmodified on every host; there is no per-core process fan-out and
+no XRT server to restart.
+
+Usage (from any machine with gcloud configured):
+    python -m vitax.launch --tpu=my-pod --zone=us-central2-b \
+        --env PYTHONUNBUFFERED=1 -- python3 run_vit_training.py --fake_data ...
+
+Features mirrored from xla_dist:
+    --env KEY=VAL ...   environment passthrough to every worker
+    --restart           kill stale python processes on workers first
+                        (--restart-tpuvm-pod-server parity)
+    --logfile PATH      tee combined output to a local file (README.md:118 parity)
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+
+def _quote_workdir(workdir: str) -> str:
+    # keep a leading ~/ unquoted so the remote shell expands it
+    if workdir.startswith("~/"):
+        return "~/" + shlex.quote(workdir[2:])
+    if workdir == "~":
+        return "~"
+    return shlex.quote(workdir)
+
+
+# Bracketed first char so the pattern does not match the pkill-carrying shell's
+# own command line (which contains this literal string).
+RESTART_CMD = "sudo pkill -f '[r]un_vit_training.py' || true; sleep 1"
+
+
+def build_remote_command(cmd: list, env: list, workdir: str) -> str:
+    exports = " ".join(f"export {shlex.quote(e)};" for e in env)
+    remote = " ".join(shlex.quote(c) for c in cmd)
+    return f"cd {_quote_workdir(workdir)} && {exports} {remote}"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--tpu", required=True, help="TPU pod name")
+    p.add_argument("--zone", default=None)
+    p.add_argument("--project", default=None)
+    p.add_argument("--env", action="append", default=[], metavar="KEY=VAL")
+    p.add_argument("--restart", action="store_true",
+                   help="kill stale training processes on all workers first")
+    p.add_argument("--workdir", default="~/vitax")
+    p.add_argument("--logfile", default=None)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- command to run on every worker")
+    args = p.parse_args(argv)
+
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        p.error("no command given (append: -- python3 run_vit_training.py ...)")
+
+    def gcloud_ssh(command: str) -> list:
+        g = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu,
+             "--worker=all", f"--command={command}"]
+        if args.zone:
+            g.append(f"--zone={args.zone}")
+        if args.project:
+            g.append(f"--project={args.project}")
+        return g
+
+    if args.restart:
+        # separate SSH round so the kill pattern cannot match (and terminate)
+        # the shell carrying the training command itself
+        print("restarting: killing stale training processes on all workers", flush=True)
+        subprocess.call(gcloud_ssh(RESTART_CMD))
+
+    gcloud = gcloud_ssh(build_remote_command(cmd, args.env, args.workdir))
+
+    print("launching:", " ".join(shlex.quote(g) for g in gcloud), flush=True)
+    if args.logfile:
+        with open(args.logfile, "ab") as log:
+            proc = subprocess.Popen(gcloud, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT)
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                sys.stdout.buffer.write(line)
+                sys.stdout.buffer.flush()
+                log.write(line)
+            return proc.wait()
+    return subprocess.call(gcloud)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

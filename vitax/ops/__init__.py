@@ -1,0 +1,5 @@
+from vitax.ops.attention import (  # noqa: F401
+    flash_attention,
+    make_attention_impl,
+    reference_attention,
+)

@@ -1,0 +1,193 @@
+"""Fused attention kernels for TPU (Pallas).
+
+The reference relies on timm's dense attention (materializes the (B,H,N,N)
+score tensor in HBM; reference run_vit_training.py:134-141 via timm Block).
+Here the softmax(QK^T/sqrt(d))V core is a Pallas kernel that keeps scores in
+VMEM — one HBM round-trip for Q/K/V/O instead of score-tensor traffic — with a
+custom VJP whose backward is also a fused kernel (flash-attention style
+recompute from the saved logsumexp).
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- grid = (batch, heads); each program computes one head's full (N, Dh)
+  attention with scores in VMEM. ViT sequence lengths are short (256 tokens at
+  224^2/patch 14), so whole-N blocks fit comfortably; the kernel is gated to
+  N <= MAX_SEQ_IN_VMEM and falls back to the dense path otherwise (long-sequence
+  scaling is handled by ring attention across chips, vitax/parallel/ring_attention.py).
+- logits accumulate in float32 on the MXU (preferred_element_type), softmax in
+  float32, outputs cast back to the activation dtype.
+- Under a multi-device mesh the kernel runs inside shard_map: batch over
+  (dp, fsdp), heads over tp — attention is embarrassingly parallel in both, so
+  no collectives are needed inside the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.sharding import Mesh, PartitionSpec as P
+
+MAX_SEQ_IN_VMEM = 2048  # (N, N) f32 scores: 16 MB at 2048 — VMEM ceiling
+
+
+def _interpret() -> bool:
+    # run the kernels in Pallas interpret mode off-TPU (tests on CPU)
+    return jax.devices()[0].platform != "tpu"
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Dense jnp attention core; (B, N, H, Dh) -> (B, N, H, Dh)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float):
+    q = q_ref[0]  # (N, Dh)
+    k = k_ref[0]
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0][None, :]
+
+
+def _fwd(q, k, v, scale):
+    """q, k, v: (BH, N, Dh) -> (o (BH, N, Dh), lse (BH, N))."""
+    bh, n, dh = q.shape
+    spec = pl.BlockSpec((1, n, dh), lambda i: (i, 0, 0))
+    lse_spec = pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale),
+        grid=(bh,),
+        in_specs=[spec, spec, spec],
+        out_specs=[spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# backward kernel
+# ---------------------------------------------------------------------------
+
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, *, scale: float):
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    o = o_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][0][:, None]  # (N, 1)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse)  # softmax probabilities, (N, N) f32
+
+    dv = jax.lax.dot_general(  # P^T dO
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(  # dO V^T
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (N, 1)
+    ds = p * (dp - delta) * scale
+
+    dq = jax.lax.dot_general(
+        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk = jax.lax.dot_general(  # dS^T Q
+        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, res, do):
+    q, k, v, o, lse = res
+    bh, n, dh = q.shape
+    spec = pl.BlockSpec((1, n, dh), lambda i: (i, 0, 0))
+    lse_spec = pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale),
+        grid=(bh,),
+        in_specs=[spec, spec, spec, spec, lse_spec, spec],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, n, dh), q.dtype)] * 3,
+        interpret=_interpret(),
+    )(q, k, v, o, lse[:, None, :], do)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_bh(q, k, v, scale):
+    o, _ = _fwd(q, k, v, scale)
+    return o
+
+
+def _flash_bh_fwd(q, k, v, scale):
+    o, lse = _fwd(q, k, v, scale)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bh.defvjp(_flash_bh_fwd, _bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Fused attention core; (B, N, H, Dh) -> (B, N, H, Dh), differentiable."""
+    b, n, h, dh = q.shape
+    scale = dh ** -0.5
+
+    def to_bh(x):  # (B, N, H, Dh) -> (B*H, N, Dh)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
+
+    o = _flash_bh(to_bh(q), to_bh(k), to_bh(v), scale)
+    return o.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
+
+
+def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
+    """Choose the attention core for this config/mesh.
+
+    Returns the Pallas kernel (wrapped in shard_map when the mesh is
+    multi-device) on TPU when shapes fit VMEM, else None (dense jnp path).
+    """
+    if not cfg.use_flash_attention:
+        return None
+    n = cfg.num_patches
+    if n > MAX_SEQ_IN_VMEM:
+        return None
+    if jax.devices()[0].platform not in ("tpu",):
+        return None
+
+    if mesh is None or mesh.size == 1:
+        return flash_attention
+
+    if mesh.shape.get("sp", 1) > 1:
+        return None  # sequence-parallel attention goes through ring attention
+
+    spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(
+        flash_attention, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )

@@ -1,0 +1,8 @@
+from vitax.parallel.mesh import MESH_AXES, build_mesh, resolve_mesh_shape, batch_pspec  # noqa: F401
+from vitax.parallel.sharding import (  # noqa: F401
+    gather_over_fsdp,
+    init_sharded_params,
+    param_pspec,
+    param_specs,
+    state_specs_like,
+)

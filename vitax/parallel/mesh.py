@@ -1,0 +1,82 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's process-per-core world
+(xmp.spawn, reference run_vit_training.py:364): one process per host, all
+devices arranged in a 4-axis `jax.sharding.Mesh`:
+
+  axes = ("dp", "fsdp", "tp", "sp")
+
+- "dp":   pure data parallelism (params replicated across it)
+- "fsdp": ZeRO-3 axis — params/grads/optimizer state sharded across it, and it
+          also carries batch parallelism (the reference's single 'data' axis)
+- "tp":   tensor parallelism (attention heads / MLP hidden sharded)
+- "sp":   sequence/context parallelism (ring attention over the token axis)
+
+The reference's FSDP corresponds to mesh shape (1, n_devices, 1, 1); its
+--run_without_fsdp DP baseline to (n_devices, 1, 1, 1). GSPMD emits the
+all-gather / reduce-scatter / all-reduce collectives over ICI from the sharding
+specs alone (SURVEY.md section 2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from vitax.config import Config
+
+MESH_AXES = ("dp", "fsdp", "tp", "sp")
+
+
+def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[int, int, int, int]:
+    """Resolve (dp, fsdp, tp, sp) against the device count. One axis may be -1
+    (= all remaining devices). `--run_without_fsdp` forces everything onto dp
+    (the reference's pure-DP baseline, run_vit_training.py:171-172)."""
+    n = n_devices if n_devices is not None else jax.device_count()
+    dp, fsdp, tp, sp = cfg.dp_size, cfg.fsdp_size, cfg.tp_size, cfg.sp_size
+
+    if cfg.run_without_fsdp:
+        if fsdp not in (-1, 1):
+            raise ValueError("--run_without_fsdp is incompatible with --fsdp_size > 1")
+        fsdp = 1
+        if dp == 1 and tp == 1 and sp == 1:
+            dp = -1  # default DP baseline: all devices data-parallel
+
+    sizes = [dp, fsdp, tp, sp]
+    n_auto = sum(1 for s in sizes if s == -1)
+    if n_auto > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {sizes}")
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if n_auto == 1:
+        if n % fixed != 0:
+            raise ValueError(f"device count {n} not divisible by fixed mesh axes {sizes}")
+        sizes[sizes.index(-1)] = n // fixed
+    elif fixed != n:
+        raise ValueError(f"mesh {sizes} does not cover {n} devices")
+    return tuple(sizes)  # type: ignore[return-value]
+
+
+def build_mesh(cfg: Config, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the 4-axis mesh. Device order follows jax.devices(), which on TPU
+    reflects physical torus coordinates — keeping the fastest-varying axis
+    ("sp", then "tp") on the closest ICI neighbors."""
+    devices = list(devices) if devices is not None else jax.devices()
+    shape = resolve_mesh_shape(cfg, len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def batch_pspec(sp_shard_tokens: bool = False) -> P:
+    """PartitionSpec for a (B, ...) batch: batch over dp+fsdp.
+
+    The reference shards the global batch across all ranks
+    (DistributedSampler, run_vit_training.py:62-64); here the same statement is
+    one PartitionSpec. With sequence parallelism the token axis of activations
+    is additionally sharded over "sp" (handled inside the model/step, not on the
+    raw image batch).
+    """
+    del sp_shard_tokens
+    return P(("dp", "fsdp"))

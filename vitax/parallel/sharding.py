@@ -1,0 +1,206 @@
+"""Sharding rules: FSDP/ZeRO-3 as PartitionSpec assignment, not module wrappers.
+
+This module is the TPU-native core replacing XlaFullyShardedDataParallel
+(reference run_vit_training.py:13,177-181; SURVEY.md section 2.2 row 1):
+
+- ZeRO-3  = every parameter (and its grad and AdamW moments) carries a
+  PartitionSpec placing one dim on the "fsdp" mesh axis. GSPMD then emits the
+  per-block all-gather before use and reduce-scatter of grads — the exact
+  collectives the reference gets from nested FSDP wrapping, but scheduled by
+  the XLA compiler with compute/communication overlap.
+- ZeRO-2  = `--no_reshard_after_forward`: params are gathered once per step
+  (see `gather_over_fsdp`) and stay live through backward; grads/opt state stay
+  sharded.
+- DP      = `--run_without_fsdp`: params replicated, batch sharded; the grad
+  all-reduce the reference does manually (xm.reduce_gradients,
+  run_vit_training.py:273) falls out of GSPMD.
+- TP      = name-based rules sharding attention heads / MLP hidden over "tp"
+  (capability the reference lacks; mesh axis reserved in SURVEY.md section 2.3).
+- `--flatten_parameters` is accepted but a no-op: flattening exists in torch FSDP
+  to amortize many small all-gathers; under GSPMD the compiler already fuses and
+  schedules collectives, so there is nothing to flatten.
+
+Sharded init (`init_sharded_params`) jits the initializer with output shardings
+so a 10B+ model is *born sharded* — no host or device ever materializes the full
+parameter tree. This subsumes the reference's `--shard_on_cpu` workaround
+(run_vit_training.py:175-181, pytorch/xla#3992); with `--shard_on_cpu` we instead
+init on host CPU and device_put shard-by-shard, which is the literal equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vitax.config import Config
+
+PyTree = Any
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "name"):
+            names.append(str(p.name))
+        elif hasattr(p, "idx"):
+            names.append(str(p.idx))
+        else:
+            names.append(str(p))
+    return tuple(names)
+
+
+# TP rules: (predicate on path names) -> dim sharded over "tp".
+# Column-parallel: qkv and fc1 shard their *output* dim; row-parallel: proj and
+# fc2 shard their *input* dim (Megatron layout: one all-reduce per pair, here
+# inserted automatically by GSPMD).
+def _tp_dim(names: Tuple[str, ...], ndim: int, last_two: Tuple[int, int]) -> Optional[int]:
+    in_dim, out_dim = last_two
+    if "qkv" in names or "fc1" in names:
+        return out_dim if names[-1] == "kernel" else (ndim - 1)  # bias: its only dim
+    if "proj" in names and "attn" in names and names[-1] == "kernel":
+        return in_dim
+    if "fc2" in names and names[-1] == "kernel":
+        return in_dim
+    return None
+
+
+def param_pspec(
+    path,
+    shape: Tuple[int, ...],
+    cfg: Config,
+    mesh_shape: Tuple[int, int, int, int],
+    scanned: bool,
+) -> P:
+    """Assign a PartitionSpec to one parameter.
+
+    Strategy: apply the TP rule (if tp > 1), then FSDP-shard the largest
+    remaining dim divisible by the fsdp axis size. The leading stacked-layers
+    dim of scanned block params is never sharded (lax.scan slices it per
+    iteration; sharding it would serialize a gather per layer).
+    """
+    _, fsdp, tp, _ = mesh_shape
+    ndim = len(shape)
+    names = _path_names(path)
+    spec: list = [None] * ndim
+
+    is_scanned_block = scanned and "blocks" in names
+    first_shardable = 1 if is_scanned_block else 0
+
+    if tp > 1:
+        tp_dim = _tp_dim(names, ndim, (ndim - 2, ndim - 1))
+        if tp_dim is not None and tp_dim >= first_shardable:
+            assert shape[tp_dim] % tp == 0, (
+                f"TP: dim {tp_dim} of {names} {shape} not divisible by tp={tp}")
+            spec[tp_dim] = "tp"
+
+    if fsdp > 1 and not cfg.run_without_fsdp:
+        # largest free dim divisible by fsdp size (ZeRO-3 shards every param;
+        # small indivisible params stay replicated, matching FSDP's handling of
+        # leftover/root params)
+        candidates = [
+            (shape[d], d) for d in range(first_shardable, ndim)
+            if spec[d] is None and shape[d] % fsdp == 0 and shape[d] >= fsdp
+        ]
+        if candidates:
+            _, d = max(candidates)
+            spec[d] = "fsdp"
+
+    return P(*spec)
+
+
+def param_specs(abstract_params: PyTree, cfg: Config, mesh: Mesh) -> PyTree:
+    """PartitionSpec tree matching an (abstract) parameter tree."""
+    mesh_shape = tuple(mesh.shape[a] for a in ("dp", "fsdp", "tp", "sp"))
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: param_pspec(path, leaf.shape, cfg, mesh_shape, cfg.scan_blocks),
+        abstract_params,
+    )
+
+
+def state_specs_like(abstract_state: PyTree, params_specs: PyTree) -> PyTree:
+    """Spec tree for a TrainState-like pytree: leaves under a `mu`/`nu` (AdamW
+    moments) or `params` subtree inherit the matching parameter's spec; scalars
+    and everything else are replicated.
+
+    This is how optimizer-state sharding (ZeRO-1) 'falls out' of param sharding
+    (SURVEY.md section 2.3): AdamW moments are param-shaped pytrees, so they
+    reuse the param specs leaf-for-leaf.
+    """
+    flat_specs = {
+        _path_names(path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(params_specs)[0]
+    }
+
+    def assign(path, leaf):
+        names = _path_names(path)
+        for marker in ("mu", "nu", "params"):
+            if marker in names:
+                sub = names[names.index(marker) + 1:]
+                # match the param subpath suffix
+                for pnames, spec in flat_specs.items():
+                    if pnames[-len(sub):] == sub if sub else False:
+                        if len(leaf.shape) == len(spec):
+                            return spec
+                break
+        return P()
+
+    return jax.tree_util.tree_map_with_path(assign, abstract_state)
+
+
+def shardings_of(mesh: Mesh, specs: PyTree) -> PyTree:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def gather_over_fsdp(specs: PyTree) -> PyTree:
+    """ZeRO-2 view of the param specs: drop the "fsdp" placement (params fully
+    gathered over fsdp for the whole step), keep TP placements. Used when
+    `--no_reshard_after_forward` is set (reference run_vit_training.py:358,174)."""
+    def strip(spec: P) -> P:
+        return P(*[None if axis == "fsdp" else axis for axis in spec])
+    return jax.tree.map(strip, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def jit_init_sharded(
+    init_fn: Callable[[jax.Array], PyTree],
+    rng: jax.Array,
+    shardings: PyTree,
+    shard_on_cpu: bool = False,
+) -> PyTree:
+    """Run an initializer so its outputs are born sharded.
+
+    Default path: `jax.jit(init_fn, out_shardings=...)` — XLA materializes each
+    array already laid out across the mesh; peak memory per device is the shard
+    size, not the full model (SURVEY.md section 7 'hard parts' #1).
+
+    `shard_on_cpu` path: run the initializer on host CPU, then `device_put`
+    leaf-by-leaf to the target sharding (each host slices out only its
+    addressable shards). Literal equivalent of FSDP's CPU-side shard
+    construction (reference run_vit_training.py:175-181, pytorch/xla#3992).
+    """
+    if shard_on_cpu:
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            host_tree = jax.jit(init_fn)(jax.device_put(rng, cpu))
+        return jax.tree.map(
+            lambda x, s: jax.device_put(np.asarray(x), s), host_tree, shardings)
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def init_sharded_params(
+    init_fn: Callable[[jax.Array], PyTree],
+    rng: jax.Array,
+    cfg: Config,
+    mesh: Mesh,
+) -> Tuple[PyTree, PyTree]:
+    """Initialize parameters directly into their FSDP/TP shards."""
+    abstract = jax.eval_shape(init_fn, rng)
+    specs = param_specs(abstract, cfg, mesh)
+    params = jit_init_sharded(init_fn, rng, shardings_of(mesh, specs), cfg.shard_on_cpu)
+    return params, specs

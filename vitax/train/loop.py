@@ -1,0 +1,188 @@
+"""Training orchestration: the reference's train() / eval_on_val() / run_logging()
+(reference run_vit_training.py:216-318; SURVEY.md sections 3.1-3.4), TPU-native.
+
+One process per host drives all local devices; the hot loop dispatches one
+compiled train_step per iteration. Device->host syncs happen only at log steps
+(the role of the reference's xm.add_step_closure throttling, run_vit_training.py:289):
+JAX's async dispatch returns futures, so we hold the metrics of the most recent
+step and fetch them when logging.
+"""
+
+from __future__ import annotations
+
+import pprint
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vitax import distributed
+from vitax.checkpoint import restore_state, save_state
+from vitax.config import Config
+from vitax.data import build_datasets
+from vitax.models import build_model, count_params
+from vitax.parallel.mesh import build_mesh
+from vitax.train.state import TrainState, build_optimizer, make_train_state
+from vitax.train.step import make_eval_step, make_train_step
+from vitax.utils.logging import master_print, memory_summary
+from vitax.utils.metrics import SmoothedValue
+
+
+def _sharded_param_count(state: TrainState) -> int:
+    """Per-device (sharded) parameter count — the reference prints this as
+    'per-TPU (sharded) parameter num' (run_vit_training.py:234)."""
+    total = 0
+    for leaf in jax.tree.leaves(state.params):
+        shard = leaf.addressable_shards[0]
+        total += int(jnp.prod(jnp.array(shard.data.shape)))
+    return total
+
+
+def train(cfg: Config) -> TrainState:
+    distributed.maybe_initialize()
+    if cfg.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+
+    master_print(f"\n=== cfg ===\n{pprint.pformat(cfg)}\n")
+    mesh = build_mesh(cfg)
+    master_print(f"mesh: {dict(mesh.shape)} over {jax.device_count()} devices "
+                 f"({jax.process_count()} host(s))")
+    attention_impl = _select_attention(cfg, mesh)
+
+    # --- datasets (reference :223-225) ---
+    train_ds, train_loader, _, val_loader = build_datasets(cfg, mesh)
+    distributed.barrier("loaded dataset")
+    master_print(f"\n=== dataset ===\n{pprint.pformat(train_ds)}\n")
+
+    # --- model + optimizer, born sharded (reference :228-242) ---
+    model = build_model(cfg, attention_impl=attention_impl)
+    steps_per_epoch = cfg.steps_per_epoch or (len(train_ds) // cfg.batch_size)
+    max_iteration = steps_per_epoch * cfg.num_epochs
+    tx, schedule = build_optimizer(cfg, max_iteration)
+    # On resume, build only the ABSTRACT state (no device materialization — the
+    # checkpoint supplies the values; reference :246-248) and restore into it.
+    state, state_specs, _ = make_train_state(
+        cfg, model, tx, mesh, jax.random.key(cfg.seed),
+        materialize=cfg.resume_epoch <= 0)
+    if cfg.resume_epoch > 0:
+        state = restore_state(cfg.ckpt_dir, cfg.resume_epoch, state)
+    distributed.barrier("loaded model")
+    master_print(f"\n=== model ===\n{model}\n")
+    master_print(f"global parameter num: {count_params(state.params)}")
+    master_print(f"per-device (sharded) parameter num: {_sharded_param_count(state)}")
+    distributed.barrier("loaded optimizer")
+
+    train_step = make_train_step(cfg, model, tx, mesh, state_specs)
+    eval_step = make_eval_step(cfg, model, mesh, state_specs)
+
+    smoothed_loss = SmoothedValue(window_size=5)
+    smoothed_time = SmoothedValue(window_size=5)
+    distributed.barrier("training begins")
+    master_print("training begins (the first few iterations are very slow due to compilation)")
+
+    prof = {"on": False}  # shared so the finally can close a mid-flight trace
+    try:
+        state = _run_epochs(
+            cfg, state, train_step, train_loader, val_loader, eval_step,
+            schedule, smoothed_loss, smoothed_time, prof)
+    finally:
+        if prof["on"]:
+            jax.profiler.stop_trace()
+            master_print(f"profile trace written to {cfg.profile_dir}")
+        train_loader.close()
+        val_loader.close()
+
+    master_print("training completed")
+    return state
+
+
+def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
+                schedule, smoothed_loss, smoothed_time, prof):
+    data_rng = jax.random.key(cfg.seed + 1)
+    total_steps = 0
+    for epoch in range(cfg.resume_epoch + 1, cfg.num_epochs + 1):
+        master_print(f"starting epoch {epoch}")
+        time_epoch_b = time_step_b = time.time()
+        metrics = None
+        for step, batch in enumerate(train_loader.epoch(epoch)):
+            if cfg.steps_per_epoch and step >= cfg.steps_per_epoch:
+                break
+            if cfg.profile_dir and total_steps == 2 and not prof["on"]:
+                jax.profiler.start_trace(cfg.profile_dir)
+                prof["on"] = True
+            state, metrics = train_step(state, batch, data_rng)
+            total_steps += 1
+            if prof["on"] and total_steps == 7:
+                jax.device_get(metrics["loss"])  # fence (block_until_ready is
+                # a no-op on some PJRT transports, e.g. the axon tunnel)
+                jax.profiler.stop_trace()
+                prof["on"] = False
+                master_print(f"profile trace written to {cfg.profile_dir}")
+
+            t_new = time.time()
+            smoothed_time.update(t_new - time_step_b, batch_size=1)
+            time_step_b = t_new
+            is_first_iter = epoch == cfg.resume_epoch + 1 and step == 0
+            if is_first_iter or (step + 1) % cfg.log_step_interval == 0:
+                _run_logging(cfg, epoch, step, metrics, schedule, smoothed_loss, smoothed_time)
+            if cfg.max_steps and total_steps >= cfg.max_steps:
+                break
+
+        if metrics is not None:
+            jax.device_get(metrics["loss"])  # fence: honest epoch wall time
+        master_print(f"epoch {epoch} done ({time.time() - time_epoch_b:.2f} sec)")
+
+        if epoch % cfg.ckpt_epoch_interval == 0 or epoch == cfg.num_epochs:
+            save_state(cfg.ckpt_dir, epoch, state)
+        if epoch % cfg.test_epoch_interval == 0 or epoch == cfg.num_epochs:
+            accuracy, _, _ = eval_on_val(cfg, val_loader, eval_step, state)
+            master_print(f"accuracy on val: {accuracy:.4f}")
+        if cfg.max_steps and total_steps >= cfg.max_steps:
+            break
+
+    return state
+
+
+def _select_attention(cfg: Config, mesh):
+    """Pick the attention core: fused Pallas kernel on TPU when shapes fit,
+    dense jnp path elsewhere (vitax.ops.attention.make_attention_impl)."""
+    from vitax.ops.attention import make_attention_impl
+    impl = make_attention_impl(cfg, mesh)
+    master_print("attention core: "
+                 + ("pallas fused kernel" if impl is not None else "dense jnp"))
+    return impl
+
+
+def _run_logging(cfg, epoch, step, metrics, schedule, smoothed_loss, smoothed_time):
+    """Throttled step log (reference run_logging, run_vit_training.py:203-213).
+
+    The loss is already the global-batch mean — the reference's
+    mesh_reduce(sum)/world_size (:205-206) is compiled into the step. Fetching
+    it here is the only device->host sync, and only on log steps."""
+    loss = float(jax.device_get(metrics["loss"]))
+    lr = float(schedule(int(jax.device_get(metrics["lr_step"]))))
+    smoothed_loss.update(loss, batch_size=1)
+    mem = f", {memory_summary()}" if cfg.log_memory else ""
+    master_print(
+        f"epoch {epoch} step {step + 1}, lr: {lr:.4f}, "
+        f"loss: {smoothed_loss.avg:.4f}, "
+        f"sec/iter: {smoothed_time.avg:.4f}{mem}"
+    )
+
+
+def eval_on_val(cfg: Config, val_loader, eval_step, state: TrainState):
+    """Top-1 accuracy over the val split (reference eval_on_val,
+    run_vit_training.py:306-318). drop_last semantics preserved: the remainder
+    of the split is ignored, exactly like the reference (:77,:83)."""
+    correct = None
+    total = 0
+    for step, batch in enumerate(val_loader.epoch(0)):
+        if cfg.eval_max_batches and step >= cfg.eval_max_batches:
+            break
+        c = eval_step(state, batch)
+        correct = c if correct is None else correct + c
+        total += cfg.batch_size
+    n_correct = int(jax.device_get(correct)) if correct is not None else 0
+    accuracy = n_correct / total if total else 0.0
+    return accuracy, n_correct, total

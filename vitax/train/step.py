@@ -1,0 +1,107 @@
+"""Jitted train/eval steps.
+
+The reference's hot loop (run_vit_training.py:259-291, SURVEY.md section 3.2) —
+forward, CE loss, backward, FSDP collectives, grad clip, AdamW update, LR step —
+is ONE compiled XLA program here. GSPMD inserts the per-layer all-gathers and
+grad reduce-scatters from the parameter shardings; the loss mean over the
+globally-sharded batch compiles to the cross-replica reduction the reference
+performs by hand (xm.mesh_reduce, run_vit_training.py:205-206).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from vitax.config import Config
+from vitax.parallel.mesh import Mesh, batch_pspec
+from vitax.parallel.sharding import gather_over_fsdp, shardings_of
+from vitax.train.state import TrainState
+
+PyTree = Any
+
+
+def _needs_dropout(cfg: Config) -> bool:
+    return (cfg.pos_dropout > 0) or (cfg.att_dropout > 0) or (cfg.mlp_dropout > 0)
+
+
+def make_train_step(
+    cfg: Config,
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_specs: PyTree,
+) -> Callable[[TrainState, Dict[str, jax.Array], jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build the jitted train step: (state, batch, rng) -> (state, metrics).
+
+    - `donate` on state: params/opt-state buffers are reused in place.
+    - ZeRO-2 mode (`--no_reshard_after_forward`): params are constrained to a
+      fully-gathered (over "fsdp") layout at the top of the step, so the
+      all-gather happens once and the gathered weights stay live through
+      backward; grads and optimizer state remain sharded.
+    """
+    state_shardings = shardings_of(mesh, state_specs)
+    batch_sharding = NamedSharding(mesh, batch_pspec())
+    rng_sharding = NamedSharding(mesh, P())
+    dropout = _needs_dropout(cfg)
+
+    def loss_fn(params, batch, rng):
+        if dropout:
+            logits = model.apply(params, batch["image"], False, rngs={"dropout": rng})
+        else:
+            logits = model.apply(params, batch["image"], True)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss
+
+    zero2 = not cfg.reshard_after_forward and not cfg.run_without_fsdp
+    gathered_shardings = (
+        shardings_of(mesh, gather_over_fsdp(state_specs.params)) if zero2 else None)
+
+    def train_step(state: TrainState, batch, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        if zero2:
+            params = jax.lax.with_sharding_constraint(state.params, gathered_shardings)
+        else:
+            params = state.params
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, step_rng)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "lr_step": state.step,  # host resolves lr via the schedule fn
+        }
+        return new_state, metrics
+
+    return jax.jit(
+        train_step,
+        in_shardings=(state_shardings, batch_sharding, rng_sharding),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(cfg: Config, model, mesh: Mesh, state_specs: PyTree):
+    """Jitted eval step: (state, batch) -> correct-prediction count over the
+    global batch (reference eval_on_val's device-side accumulator + mesh_reduce,
+    run_vit_training.py:306-318, as one compiled reduction)."""
+    state_shardings = shardings_of(mesh, state_specs)
+    batch_sharding = NamedSharding(mesh, batch_pspec())
+
+    def eval_step(state: TrainState, batch):
+        logits = model.apply(state.params, batch["image"], True)
+        pred = jnp.argmax(logits, axis=-1)
+        return jnp.sum((pred == batch["label"]).astype(jnp.int32))
+
+    return jax.jit(
+        eval_step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=None,
+    )
