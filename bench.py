@@ -54,6 +54,10 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="l14", choices=["tiny", "l14", "10b"])
     p.add_argument("--batch_size", type=int, default=0)
+    p.add_argument("--remat_policy", default="none_saveable",
+                   choices=["none_saveable", "dots_saveable"])
+    p.add_argument("--no_grad_ckpt", action="store_false", dest="grad_ckpt")
+    p.add_argument("--no_flash_attention", action="store_false", dest="use_flash_attention")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=8)
     args = p.parse_args()
@@ -77,7 +81,9 @@ def main():
     kw = presets[args.preset]
     if args.batch_size:
         kw["batch_size"] = args.batch_size
-    cfg = Config(num_classes=1000, warmup_steps=0, **kw).validate()
+    cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
+                 grad_ckpt=args.grad_ckpt,
+                 use_flash_attention=args.use_flash_attention, **kw).validate()
 
     mesh = build_mesh(cfg)
     from vitax.ops.attention import make_attention_impl

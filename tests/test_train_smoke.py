@@ -39,8 +39,13 @@ def random_batch(cfg, mesh, seed=0):
 
 
 def run_steps(cfg, n_steps=8, seed=0):
+    """Build the full sharded step exactly as the training loop does
+    (attention impl + token sharding selection included) and run n steps."""
+    from vitax.ops.attention import make_attention_impl
+    from vitax.train.loop import _token_sharding
     mesh = build_mesh(cfg)
-    model = build_model(cfg)
+    model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh),
+                        token_sharding=_token_sharding(cfg, mesh))
     tx, schedule = build_optimizer(cfg, max_iteration=100)
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(cfg.seed))
     step_fn = make_train_step(cfg, model, tx, mesh, sspecs)

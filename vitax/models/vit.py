@@ -231,6 +231,9 @@ class VisionTransformer(nn.Module):
     grad_ckpt: bool = True
     remat_policy: str = "none_saveable"
     attention_impl: Optional[Callable] = None
+    # NamedSharding for (B, N, D) activations — anchors GSPMD batch sharding
+    # and shards the token axis over "sp" for sequence parallelism
+    token_sharding: Optional[Any] = None
 
     @nn.compact
     def __call__(self, images: Array, deterministic: bool = True) -> Array:
@@ -246,6 +249,8 @@ class VisionTransformer(nn.Module):
             "pos_embed", default_init, (1, num_patches, self.embed_dim), jnp.float32)
         x = x + pos_embed.astype(self.dtype)
         x = nn.Dropout(rate=self.pos_dropout)(x, deterministic=deterministic)
+        if self.token_sharding is not None:
+            x = jax.lax.with_sharding_constraint(x, self.token_sharding)
 
         block_kwargs = dict(
             num_heads=self.num_heads,
@@ -294,7 +299,8 @@ class VisionTransformer(nn.Module):
         return logits
 
 
-def build_model(cfg: Config, attention_impl: Optional[Callable] = None) -> VisionTransformer:
+def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
+                token_sharding=None) -> VisionTransformer:
     """Construct the model from config (reference build_fsdp_vit_model parity,
     run_vit_training.py:165-200 — minus the wrapping, which in vitax is a sharding
     declaration applied at jit boundaries, not a module transform)."""
@@ -314,6 +320,7 @@ def build_model(cfg: Config, attention_impl: Optional[Callable] = None) -> Visio
         grad_ckpt=cfg.grad_ckpt,
         remat_policy=cfg.remat_policy,
         attention_impl=attention_impl,
+        token_sharding=token_sharding,
     )
 
 

@@ -165,14 +165,26 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 
 
 def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
-    """Choose the attention core for this config/mesh.
+    """Choose the attention core for this config/mesh:
 
-    Returns the Pallas kernel (wrapped in shard_map when the mesh is
-    multi-device) on TPU when shapes fit VMEM, else None (dense jnp path).
+    - sp > 1: ring attention over the sequence axis (works on any backend —
+      the long-context path; vitax/parallel/ring_attention.py)
+    - TPU, shapes fit VMEM: the fused Pallas kernel (shard_map-wrapped on
+      multi-device meshes)
+    - otherwise: None -> dense jnp path (GSPMD still shards batch/heads)
     """
+    n = cfg.num_patches
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+
+    if sp > 1:
+        if n % sp != 0 or cfg.num_heads % tp != 0:
+            return None  # indivisible: let GSPMD handle the dense path
+        from vitax.parallel.ring_attention import make_ring_attention
+        return make_ring_attention(mesh)
+
     if not cfg.use_flash_attention:
         return None
-    n = cfg.num_patches
     if n > MAX_SEQ_IN_VMEM:
         return None
     if jax.devices()[0].platform not in ("tpu",):
@@ -181,9 +193,8 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     if mesh is None or mesh.size == 1:
         return flash_attention
 
-    if mesh.shape.get("sp", 1) > 1:
-        return None  # sequence-parallel attention goes through ring attention
-
+    if cfg.num_heads % tp != 0:
+        return None
     spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
     from jax.experimental.shard_map import shard_map
     return shard_map(

@@ -1,0 +1,73 @@
+"""Ring attention: sequence/context parallelism over the "sp" mesh axis.
+
+Long-context capability beyond the reference (which fixes sequence length at
+(image/patch)^2 = 256 tokens and scales only parameters — SURVEY.md section 5
+'long-context: absent'): activations are sharded over the token axis, and
+attention streams K/V blocks around the ring of "sp" neighbors via
+`jax.lax.ppermute` (one ICI hop per step), merging partial results with the
+online-softmax recurrence (blockwise attention a la Ring Attention,
+arXiv:2310.01889). Peak memory per chip is O(N/sp) activations and one K/V
+block; the (N, N) score matrix never exists.
+
+Collectives ride the ICI ring — ppermute is the bandwidth-optimal primitive
+for neighbor exchange (see the scaling-book recipe: shard, permute, overlap).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, scale: float):
+    """shard_map body. q, k, v: (B, N_loc, H, Dh) — the local token shard.
+    Streams K/V blocks around the ring, merging with online softmax."""
+    sp = jax.lax.axis_size(axis_name)
+    b, n_loc, h, dh = q.shape
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((b, h, n_loc, 1), -jnp.inf, jnp.float32)   # running row max
+    l = jnp.zeros((b, h, n_loc, 1), jnp.float32)            # running denominator
+    o = jnp.zeros((b, h, n_loc, dh), jnp.float32)           # unnormalized out
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        # rotate K/V to the next ring neighbor (skipped after the last block)
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l, o
+
+    _, _, _, l, o = jax.lax.fori_loop(0, sp, body, (k, v, m, l, o))
+    out = (o / l).transpose(0, 2, 1, 3)  # (B, N_loc, H, Dh)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
+    """Build a (B, N, H, Dh) -> (B, N, H, Dh) attention core with the token
+    axis sharded over `axis_name`; batch over (dp, fsdp), heads over tp."""
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+
+    def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        scale = q.shape[-1] ** -0.5
+        fn = shard_map(
+            functools.partial(_ring_attention_local, axis_name=axis_name, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
+        return fn(q, k, v)
+
+    return ring_attention

@@ -56,7 +56,8 @@ def train(cfg: Config) -> TrainState:
     master_print(f"\n=== dataset ===\n{pprint.pformat(train_ds)}\n")
 
     # --- model + optimizer, born sharded (reference :228-242) ---
-    model = build_model(cfg, attention_impl=attention_impl)
+    model = build_model(cfg, attention_impl=attention_impl,
+                        token_sharding=_token_sharding(cfg, mesh))
     steps_per_epoch = cfg.steps_per_epoch or (len(train_ds) // cfg.batch_size)
     max_iteration = steps_per_epoch * cfg.num_epochs
     tx, schedule = build_optimizer(cfg, max_iteration)
@@ -142,6 +143,17 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             break
 
     return state
+
+
+def _token_sharding(cfg: Config, mesh):
+    """(B, N, D) activation sharding: batch over (dp, fsdp), tokens over sp.
+    Anchors GSPMD propagation; None on single-device meshes."""
+    if mesh.size == 1:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sp = mesh.shape.get("sp", 1)
+    token_axis = "sp" if (sp > 1 and cfg.num_patches % sp == 0) else None
+    return NamedSharding(mesh, P(("dp", "fsdp"), token_axis, None))
 
 
 def _select_attention(cfg: Config, mesh):

@@ -72,7 +72,10 @@ def make_train_state(
 
     Returns (state, state_specs, param_specs).
     """
-    sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    # sample batch must divide evenly over the (dp, fsdp) batch axes — the
+    # attention shard_map paths trace through init
+    sample_b = mesh.shape["dp"] * mesh.shape["fsdp"]
+    sample = jnp.zeros((sample_b, cfg.image_size, cfg.image_size, 3), jnp.float32)
 
     def init_fn(rng):
         params = model.init(rng, sample, True)
