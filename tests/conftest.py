@@ -13,8 +13,9 @@ if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+from vitax.platform import force_cpu_if_requested  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_if_requested()
 
 import pytest  # noqa: E402
 

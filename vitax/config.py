@@ -68,7 +68,11 @@ class Config:
     tp_size: int = 1
     sp_size: int = 1
     scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
-    remat_policy: str = "none_saveable" # none_saveable | dots_saveable | nothing (only used if grad_ckpt)
+    # none_saveable = the reference's checkpoint_module semantics (recompute
+    # everything) and the least HBM — the right default for the 10B+ flagship.
+    # dots_saveable (keep MXU outputs, recompute elementwise) measured faster
+    # where it fits (v5e l14: 164.2 vs 155.8 img/s/chip) — bench selects it.
+    remat_policy: str = "none_saveable" # none_saveable | dots_saveable (only used if grad_ckpt)
     profile_dir: str = ""               # if set, capture a jax.profiler trace of a few steps
     debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
     log_memory: bool = True             # include HBM stats in step log
@@ -139,7 +143,7 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--tp_size", type=int, default=1)
     ext.add_argument("--sp_size", type=int, default=1)
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
-    ext.add_argument("--remat_policy", type=str, default="none_saveable",
+    ext.add_argument("--remat_policy", type=str, default=Config.remat_policy,
                      choices=["none_saveable", "dots_saveable"])
     ext.add_argument("--profile_dir", type=str, default="")
     ext.add_argument("--debug_nans", action="store_true", dest="debug_nans")

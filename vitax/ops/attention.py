@@ -196,9 +196,8 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     if cfg.num_heads % tp != 0:
         return None
     spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
-    from jax.experimental.shard_map import shard_map
-    return shard_map(
+    return jax.shard_map(
         flash_attention, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )

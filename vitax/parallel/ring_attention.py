@@ -20,7 +20,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -63,10 +62,10 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
 
     def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         scale = q.shape[-1] ** -0.5
-        fn = shard_map(
+        fn = jax.shard_map(
             functools.partial(_ring_attention_local, axis_name=axis_name, scale=scale),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_rep=False,
+            check_vma=False,
         )
         return fn(q, k, v)
 

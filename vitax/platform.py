@@ -1,0 +1,17 @@
+"""Backend platform selection helpers.
+
+Import-order-sensitive: call `force_cpu_if_requested()` before anything queries
+`jax.devices()`. Under an experimental TPU plugin (axon), the JAX_PLATFORMS env
+var alone does not stop the plugin from claiming the backend — the config flag
+set before first backend init does.
+"""
+
+import os
+
+import jax
+
+
+def force_cpu_if_requested() -> None:
+    """Honor JAX_PLATFORMS=cpu even when a TPU plugin would claim the backend."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
