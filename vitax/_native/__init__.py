@@ -1,0 +1,74 @@
+"""Build + load the native (C++) data-path library.
+
+The .so is compiled from decode.cc on first use (g++ -O3, links libjpeg) and
+cached next to the source; a stale .so (older than the source) is rebuilt.
+Everything degrades gracefully: if the toolchain or libjpeg is missing,
+`load()` returns None and callers fall back to the PIL path
+(vitax/data/transforms.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "decode.cc")
+_SO = os.path.join(_DIR, "libvitax_data.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _build() -> None:
+    tmp = _SO + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        _SRC, "-o", tmp, "-ljpeg", "-pthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _SO)  # atomic: concurrent builders race benignly
+
+
+def _prototype(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.vitax_jpeg_size.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.vitax_jpeg_size.restype = ctypes.c_int
+    lib.vitax_process_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.vitax_process_file.restype = ctypes.c_int
+    lib.vitax_process_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int]
+    lib.vitax_process_batch.restype = ctypes.c_int
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            _lib = _prototype(ctypes.CDLL(_SO))
+        except Exception:
+            _failed = True
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

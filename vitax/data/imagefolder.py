@@ -19,9 +19,21 @@ IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
 
 
 class ImageFolderDataset:
-    def __init__(self, root: str, transform: Optional[Callable] = None):
+    """`use_native=None` (auto) routes JPEG decode + transform through the C++
+    pipeline (vitax/data/native.py) when the library is available and the
+    transform exposes native_params(); anything else (PNG/TIFF, corrupt files,
+    no toolchain) falls back to the PIL path per item."""
+
+    def __init__(self, root: str, transform: Optional[Callable] = None,
+                 use_native: Optional[bool] = None):
         self.root = root
         self.transform = transform
+        from vitax.data import native
+        self._native = native
+        if use_native is None:
+            use_native = native.available()
+        self.use_native = (use_native and transform is not None
+                           and hasattr(transform, "native_params"))
         self.classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
         if not self.classes:
@@ -43,13 +55,83 @@ class ImageFolderDataset:
         if self.transform is not None and hasattr(self.transform, "set_epoch"):
             self.transform.set_epoch(epoch)
 
-    def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
+    def _shape_args(self) -> Tuple[int, int]:
+        """(out_size, resize_to) for the native calls."""
+        return self.transform.image_size, getattr(self.transform, "resize_to", 0)
+
+    def _native_params(self, idx: int) -> Optional[Tuple[int, ...]]:
+        """Transform params for the native pipeline, or None to use PIL."""
+        path, _ = self.samples[idx]
+        if not self._native.is_jpeg_path(path):
+            return None
+        size = self._native.jpeg_size(path)
+        if size is None:
+            return None
+        return self.transform.native_params(size[0], size[1], idx)
+
+    def _pil_item(self, idx: int) -> Tuple[np.ndarray, int]:
         path, label = self.samples[idx]
         with Image.open(path) as img:
             img = img.convert("RGB")
             if self.transform is not None:
                 return self.transform(img, index=idx), label
             return np.asarray(img, np.float32) / 255.0, label
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
+        if self.use_native:
+            params = self._native_params(idx)
+            if params is not None:
+                out_size, resize_to = self._shape_args()
+                arr = self._native.process_file(
+                    self.samples[idx][0], params, out_size, resize_to)
+                if arr is not None:
+                    return arr, self.samples[idx][1]
+        return self._pil_item(idx)
+
+    def load_batch(self, indices, n_threads: int = 8
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Whole-batch path: one GIL-free C++ call decodes + transforms every
+        JPEG on a std::thread pool; non-JPEG or failed items fall back to PIL.
+        Returns (images (N, S, S, 3) float32, labels (N,) int32)."""
+        indices = list(indices)
+        labels = np.asarray([self.samples[i][1] for i in indices], np.int32)
+        out_size, resize_to = self._shape_args()
+
+        native_pos, params = [], []
+        for pos, i in enumerate(indices):
+            p = self._native_params(i) if self.use_native else None
+            if p is not None:
+                native_pos.append(pos)
+                params.append(p)
+
+        images = np.empty((len(indices), out_size, out_size, 3), np.float32)
+        native_set = set(native_pos)
+        fallback = [pos for pos in range(len(indices)) if pos not in native_set]
+        if native_pos:
+            batch, failed = self._native.process_batch(
+                [self.samples[indices[pos]][0] for pos in native_pos], params,
+                out_size, resize_to, n_threads)
+            if batch is None:
+                fallback = list(range(len(indices)))
+            else:
+                failed_set = set(failed)
+                for j, pos in enumerate(native_pos):
+                    if j in failed_set:
+                        fallback.append(pos)
+                    else:
+                        images[pos] = batch[j]
+        if len(fallback) > 1:
+            # parallel PIL fallback (PIL releases the GIL during decode) — a
+            # mostly-non-JPEG batch keeps the pre-native path's parallelism
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(min(n_threads, len(fallback))) as pool:
+                for pos, (img, _) in zip(fallback, pool.map(
+                        self._pil_item, (indices[pos] for pos in fallback))):
+                    images[pos] = img
+        else:
+            for pos in fallback:
+                images[pos] = self._pil_item(indices[pos])[0]
+        return images, labels
 
     def __len__(self) -> int:
         return len(self.samples)

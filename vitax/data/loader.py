@@ -85,6 +85,10 @@ class ShardedLoader:
                                         thread_name_prefix="vitax-data")
 
     def _load_local(self, indices: Sequence[int]) -> Dict[str, np.ndarray]:
+        if getattr(self.dataset, "use_native", False):
+            # whole-batch native path: one GIL-free C++ call, its own thread pool
+            images, labels = self.dataset.load_batch(indices, self.num_workers)
+            return {"image": images, "label": labels}
         items = list(self._pool.map(self.dataset.__getitem__, indices))
         images = np.stack([it[0] for it in items]).astype(np.float32)
         labels = np.asarray([it[1] for it in items], np.int32)

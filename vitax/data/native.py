@@ -1,0 +1,79 @@
+"""numpy-facing wrappers over the native (C++) data-path library.
+
+Sits between the datasets (vitax/data/imagefolder.py) and the ctypes library
+(vitax/_native): single-image and batched decode+transform calls that fill
+float32 HWC arrays. The batched call runs libjpeg decode + PIL-parity bicubic
+resample + normalize across a C++ std::thread pool — one GIL-free call per
+local batch, replacing the reference's DataLoader worker *processes*
+(reference run_vit_training.py:65-73).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from vitax import _native
+
+_JPEG_EXT = (".jpg", ".jpeg", ".jpe", ".jfif")
+
+
+def available() -> bool:
+    return _native.available()
+
+
+def is_jpeg_path(path: str) -> bool:
+    return path.lower().endswith(_JPEG_EXT)
+
+
+def jpeg_size(path: str) -> Optional[Tuple[int, int]]:
+    """(width, height) from the JPEG header, or None on failure."""
+    lib = _native.load()
+    if lib is None:
+        return None
+    w, h = ctypes.c_int(), ctypes.c_int()
+    if lib.vitax_jpeg_size(path.encode(), ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None
+    return w.value, h.value
+
+
+def process_file(path: str, params: Sequence[int], out_size: int,
+                 resize_to: int) -> Optional[np.ndarray]:
+    """Decode + transform one JPEG; params = (mode, left, top, cw, ch, flip)
+    from a transform's native_params(). Returns (S, S, 3) float32 or None."""
+    lib = _native.load()
+    if lib is None:
+        return None
+    out = np.empty((out_size, out_size, 3), np.float32)
+    mode, left, top, cw, ch, flip = (int(x) for x in params)
+    rc = lib.vitax_process_file(
+        path.encode(), mode, left, top, cw, ch, flip, out_size, resize_to,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out if rc == 0 else None
+
+
+def process_batch(paths: Sequence[str], params: Sequence[Sequence[int]],
+                  out_size: int, resize_to: int, n_threads: int = 8
+                  ) -> Tuple[Optional[np.ndarray], List[int]]:
+    """Decode + transform a batch on the C++ thread pool.
+
+    Returns (batch (N, S, S, 3) float32, failed_indices); failed slots are
+    untouched and must be filled by the caller's fallback path. Returns
+    (None, all indices) if the native library is unavailable.
+    """
+    n = len(paths)
+    if _native.load() is None:
+        return None, list(range(n))
+    lib = _native.load()
+    out = np.empty((n, out_size, out_size, 3), np.float32)
+    fail = np.zeros(n, np.uint8)
+    params_arr = np.ascontiguousarray(params, np.int32).reshape(n, 6)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    lib.vitax_process_batch(
+        c_paths, n, params_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_size, resize_to,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        fail.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n_threads)
+    return out, list(np.nonzero(fail)[0])

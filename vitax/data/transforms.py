@@ -31,12 +31,13 @@ def _to_normalized_array(img: Image.Image) -> np.ndarray:
     return (arr - IMAGENET_MEAN) / IMAGENET_STD
 
 
-def random_resized_crop(img: Image.Image, size: int, rng: np.random.Generator,
-                        scale: Tuple[float, float] = (0.08, 1.0),
-                        ratio: Tuple[float, float] = (3 / 4, 4 / 3)) -> Image.Image:
+def get_crop_params(width: int, height: int, rng: np.random.Generator,
+                    scale: Tuple[float, float] = (0.08, 1.0),
+                    ratio: Tuple[float, float] = (3 / 4, 4 / 3)
+                    ) -> Tuple[int, int, int, int]:
     """torchvision RandomResizedCrop.get_params algorithm: 10 attempts at a
-    random area/aspect crop, then center-crop fallback with clamped ratio."""
-    width, height = img.size
+    random area/aspect crop, then center-crop fallback with clamped ratio.
+    Returns (left, top, w, h)."""
     area = width * height
     log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
 
@@ -48,8 +49,7 @@ def random_resized_crop(img: Image.Image, size: int, rng: np.random.Generator,
         if 0 < w <= width and 0 < h <= height:
             top = int(rng.integers(0, height - h + 1))
             left = int(rng.integers(0, width - w + 1))
-            return img.resize((size, size), BICUBIC,
-                              box=(left, top, left + w, top + h))
+            return left, top, w, h
 
     # fallback: center crop at the closest valid ratio
     in_ratio = width / height
@@ -59,7 +59,13 @@ def random_resized_crop(img: Image.Image, size: int, rng: np.random.Generator,
         h, w = height, int(round(height * ratio[1]))
     else:
         w, h = width, height
-    left, top = (width - w) // 2, (height - h) // 2
+    return (width - w) // 2, (height - h) // 2, w, h
+
+
+def random_resized_crop(img: Image.Image, size: int, rng: np.random.Generator,
+                        scale: Tuple[float, float] = (0.08, 1.0),
+                        ratio: Tuple[float, float] = (3 / 4, 4 / 3)) -> Image.Image:
+    left, top, w, h = get_crop_params(img.size[0], img.size[1], rng, scale, ratio)
     return img.resize((size, size), BICUBIC, box=(left, top, left + w, top + h))
 
 
@@ -103,6 +109,16 @@ class TrainTransform:
             img = img.transpose(Image.Transpose.FLIP_LEFT_RIGHT)
         return _to_normalized_array(img)
 
+    def native_params(self, width: int, height: int, index: int):
+        """(mode, left, top, cw, ch, flip) for the native C++ pipeline — the
+        SAME rng stream/order as __call__, so PIL and native paths apply
+        identical augmentations and differ only in resample rounding."""
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [self.seed, self.epoch, index]))
+        left, top, w, h = get_crop_params(width, height, rng)
+        flip = int(rng.random() < 0.5)
+        return (0, left, top, w, h, flip)
+
 
 class ValTransform:
     """Reference val stack (run_vit_training.py:48-55): resize shorter side to
@@ -119,6 +135,9 @@ class ValTransform:
         img = resize_shorter(img, self.resize_to)
         img = center_crop(img, self.image_size)
         return _to_normalized_array(img)
+
+    def native_params(self, width: int, height: int, index: int):
+        return (1, 0, 0, 0, 0, 0)  # val pipeline is parameter-free
 
 
 def train_transform(image_size: int, seed: int = 0) -> TrainTransform:
