@@ -110,6 +110,40 @@ def test_batch_reports_failures(tmp_path):
     assert np.isfinite(batch[0]).all()
 
 
+def test_uint8_output_matches_device_normalize(tmp_path):
+    """Raw-uint8 output + on-device normalization == float output: the
+    device_normalize transport optimization must not change numerics."""
+    import jax.numpy as jnp
+    from vitax.train.step import prepare_images
+
+    p = str(tmp_path / "x.jpg")
+    _save_jpeg(p, 200, 150)
+    vt = val_transform(64)
+    params = vt.native_params(200, 150, 0)
+    f32 = native.process_file(p, params, 64, vt.resize_to, normalize=True)
+    u8 = native.process_file(p, params, 64, vt.resize_to, normalize=False)
+    assert u8.dtype == np.uint8
+    on_device = np.asarray(prepare_images(jnp.asarray(u8)))
+    np.testing.assert_allclose(on_device, f32, atol=1e-6)
+    # float input passes through untouched
+    assert prepare_images(jnp.asarray(f32)).dtype == jnp.float32
+
+
+def test_uint8_pil_and_native_paths_agree(tmp_path):
+    root = tmp_path / "train"
+    os.makedirs(root / "a")
+    _save_jpeg(str(root / "a" / "0.jpg"), 300, 200, seed=1)
+    tt = train_transform(64, seed=0, normalize=False)
+    ds_native = ImageFolderDataset(str(root), tt, use_native=True)
+    ds_pil = ImageFolderDataset(str(root), tt, use_native=False)
+    img_n, _ = ds_native[0]
+    img_p, _ = ds_pil[0]
+    assert img_n.dtype == np.uint8 and img_p.dtype == np.uint8
+    assert np.abs(img_n.astype(int) - img_p.astype(int)).max() <= 1  # 1 LSB
+    imgs, _ = ds_native.load_batch([0])
+    assert imgs.dtype == np.uint8
+
+
 def test_imagefolder_native_matches_pil_dataset(tmp_path):
     root = tmp_path / "train"
     for cls in ("a", "b"):

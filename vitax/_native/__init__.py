@@ -40,13 +40,13 @@ def _prototype(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.vitax_jpeg_size.restype = ctypes.c_int
     lib.vitax_process_file.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_float)]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p]
     lib.vitax_process_file.restype = ctypes.c_int
     lib.vitax_process_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
         ctypes.c_int]
     lib.vitax_process_batch.restype = ctypes.c_int
     return lib

@@ -4,9 +4,10 @@
 // (reference run_vit_training.py:39-55,65-73: DataLoader worker processes doing
 // libjpeg decode + RandomResizedCrop/Resize/CenterCrop via PIL). Here the whole
 // per-image pixel path is one C++ call (libjpeg decode -> PIL-parity separable
-// bicubic resample -> crop/flip -> ImageNet normalize into the caller's float32
-// buffer), plus a std::thread batch API so one ctypes call fills a whole local
-// batch without touching the GIL.
+// bicubic resample -> crop/flip -> output: ImageNet-normalized float32, or raw
+// uint8 at 1/4 the buffer size when `normalize` is 0 and the train step
+// normalizes on-device), plus a std::thread batch API so one ctypes call fills
+// a whole local batch without touching the GIL.
 //
 // Resampling matches Pillow's ImagingResample algorithm (separable convolution,
 // filter support scaled by the downscale factor, uint8 intermediate between the
@@ -239,19 +240,38 @@ void normalize_out(const std::vector<uint8_t>& img, int size, int flip, float* o
   }
 }
 
+// Write raw (size, size, 3) uint8, optionally h-flipped — the device-side
+// normalization path: the train step divides/normalizes on the TPU, making
+// the host->device transfer 4x smaller than float32.
+void raw_out(const std::vector<uint8_t>& img, int size, int flip, uint8_t* out) {
+  for (int y = 0; y < size; y++) {
+    const uint8_t* row = img.data() + static_cast<size_t>(y) * size * 3;
+    uint8_t* orow = out + static_cast<size_t>(y) * size * 3;
+    if (!flip) {
+      std::memcpy(orow, row, static_cast<size_t>(size) * 3);
+      continue;
+    }
+    for (int x = 0; x < size; x++) {
+      const uint8_t* p = row + static_cast<size_t>(size - 1 - x) * 3;
+      orow[x * 3 + 0] = p[0];
+      orow[x * 3 + 1] = p[1];
+      orow[x * 3 + 2] = p[2];
+    }
+  }
+}
+
 // mode 0 (train): resize the (left, top, cw, ch) box to (out_size, out_size).
 // mode 1 (val): resize shorter side to resize_to, center crop out_size
 //               (zero-padding if smaller — transforms.center_crop parity).
+// On success `pixels` holds (out_size, out_size, 3) uint8, pre-flip.
 bool process_decoded(const std::vector<uint8_t>& rgb, int w, int h, int mode,
-                     int left, int top, int cw, int ch, int flip, int out_size,
-                     int resize_to, float* out) {
-  std::vector<uint8_t> resized;
+                     int left, int top, int cw, int ch, int out_size,
+                     int resize_to, std::vector<uint8_t>& pixels) {
   if (mode == 0) {
     if (cw <= 0 || ch <= 0 || left < 0 || top < 0 || left + cw > w || top + ch > h)
       return false;
     resample(rgb.data(), w, h, left, top, left + cw, top + ch, out_size, out_size,
-             resized);
-    normalize_out(resized, out_size, flip, out);
+             pixels);
     return true;
   }
   // val: resize shorter side (transforms.resize_shorter parity)
@@ -265,19 +285,19 @@ bool process_decoded(const std::vector<uint8_t>& rgb, int w, int h, int mode,
     new_h = resize_to;
     new_w = std::max(1L, std::lrint(static_cast<double>(resize_to) * w / h));
   }
+  std::vector<uint8_t> resized;
   resample(rgb.data(), w, h, 0.0, 0.0, w, h, new_w, new_h, resized);
   // center crop with zero pad
-  std::vector<uint8_t> cropped(static_cast<size_t>(out_size) * out_size * 3, 0);
+  pixels.assign(static_cast<size_t>(out_size) * out_size * 3, 0);
   int cl = (new_w - out_size) / 2, ct = (new_h - out_size) / 2;
   // crop window intersected with the image; destination offset when padding
   int x0 = std::max(cl, 0), y0 = std::max(ct, 0);
   int x1 = std::min(cl + out_size, new_w), y1 = std::min(ct + out_size, new_h);
   for (int y = y0; y < y1; y++) {
-    std::memcpy(cropped.data() + (static_cast<size_t>(y - ct) * out_size + (x0 - cl)) * 3,
+    std::memcpy(pixels.data() + (static_cast<size_t>(y - ct) * out_size + (x0 - cl)) * 3,
                 resized.data() + (static_cast<size_t>(y) * new_w + x0) * 3,
                 static_cast<size_t>(x1 - x0) * 3);
   }
-  normalize_out(cropped, out_size, flip, out);
   return true;
 }
 
@@ -290,32 +310,45 @@ int vitax_jpeg_size(const char* path, int* w, int* h) {
   return read_jpeg_size(path, *w, *h) ? 0 : 1;
 }
 
-// Decode + process one file into out[out_size*out_size*3]. Returns 0 on success.
+// Decode + process one file into out[out_size*out_size*3]: float32 normalized
+// when normalize != 0, else raw uint8. Returns 0 on success.
 int vitax_process_file(const char* path, int mode, int left, int top, int cw,
-                       int ch, int flip, int out_size, int resize_to, float* out) {
+                       int ch, int flip, int out_size, int resize_to,
+                       int normalize, void* out) {
   std::vector<uint8_t> rgb;
   int w, h;
   if (!decode_jpeg_file(path, rgb, w, h)) return 1;
-  return process_decoded(rgb, w, h, mode, left, top, cw, ch, flip, out_size,
-                         resize_to, out) ? 0 : 1;
+  std::vector<uint8_t> pixels;
+  if (!process_decoded(rgb, w, h, mode, left, top, cw, ch, out_size, resize_to,
+                       pixels))
+    return 1;
+  if (normalize)
+    normalize_out(pixels, out_size, flip, static_cast<float*>(out));
+  else
+    raw_out(pixels, out_size, flip, static_cast<uint8_t*>(out));
+  return 0;
 }
 
 // Batch: params is n x 6 int32 rows {mode, left, top, cw, ch, flip}; out is
-// (n, out_size, out_size, 3) float32; fail is n uint8 flags (1 = this item
-// failed and its slot is untouched — caller falls back per item). Work is
-// spread over n_threads std::threads (no GIL involvement). Returns #failures.
+// (n, out_size, out_size, 3) — float32 when normalize != 0, else uint8; fail
+// is n uint8 flags (1 = this item failed and its slot is untouched — caller
+// falls back per item). Work is spread over n_threads std::threads (no GIL
+// involvement). Returns #failures.
 int vitax_process_batch(const char** paths, int n, const int32_t* params,
-                        int out_size, int resize_to, float* out, uint8_t* fail,
-                        int n_threads) {
+                        int out_size, int resize_to, int normalize, void* out,
+                        uint8_t* fail, int n_threads) {
   std::atomic<int> next(0), failures(0);
+  size_t item = static_cast<size_t>(out_size) * out_size * 3;
   auto worker = [&]() {
     for (;;) {
       int i = next.fetch_add(1);
       if (i >= n) return;
       const int32_t* p = params + static_cast<size_t>(i) * 6;
-      float* o = out + static_cast<size_t>(i) * out_size * out_size * 3;
+      void* o = normalize
+          ? static_cast<void*>(static_cast<float*>(out) + item * i)
+          : static_cast<void*>(static_cast<uint8_t*>(out) + item * i);
       int ok = vitax_process_file(paths[i], p[0], p[1], p[2], p[3], p[4], p[5],
-                                  out_size, resize_to, o);
+                                  out_size, resize_to, normalize, o);
       fail[i] = static_cast<uint8_t>(ok != 0);
       if (ok != 0) failures.fetch_add(1);
     }

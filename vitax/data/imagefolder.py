@@ -34,6 +34,7 @@ class ImageFolderDataset:
             use_native = native.available()
         self.use_native = (use_native and transform is not None
                            and hasattr(transform, "native_params"))
+        self._normalize = getattr(transform, "normalize", True)
         self.classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
         if not self.classes:
@@ -83,7 +84,8 @@ class ImageFolderDataset:
             if params is not None:
                 out_size, resize_to = self._shape_args()
                 arr = self._native.process_file(
-                    self.samples[idx][0], params, out_size, resize_to)
+                    self.samples[idx][0], params, out_size, resize_to,
+                    normalize=self._normalize)
                 if arr is not None:
                     return arr, self.samples[idx][1]
         return self._pil_item(idx)
@@ -92,10 +94,13 @@ class ImageFolderDataset:
                    ) -> Tuple[np.ndarray, np.ndarray]:
         """Whole-batch path: one GIL-free C++ call decodes + transforms every
         JPEG on a std::thread pool; non-JPEG or failed items fall back to PIL.
-        Returns (images (N, S, S, 3) float32, labels (N,) int32)."""
+        Returns (images (N, S, S, 3), labels (N,) int32); images are normalized
+        float32, or raw uint8 when the transform has normalize=False (the
+        device-side normalization path)."""
         indices = list(indices)
         labels = np.asarray([self.samples[i][1] for i in indices], np.int32)
         out_size, resize_to = self._shape_args()
+        dtype = np.float32 if self._normalize else np.uint8
 
         native_pos, params = [], []
         for pos, i in enumerate(indices):
@@ -104,13 +109,13 @@ class ImageFolderDataset:
                 native_pos.append(pos)
                 params.append(p)
 
-        images = np.empty((len(indices), out_size, out_size, 3), np.float32)
+        images = np.empty((len(indices), out_size, out_size, 3), dtype)
         native_set = set(native_pos)
         fallback = [pos for pos in range(len(indices)) if pos not in native_set]
         if native_pos:
             batch, failed = self._native.process_batch(
                 [self.samples[indices[pos]][0] for pos in native_pos], params,
-                out_size, resize_to, n_threads)
+                out_size, resize_to, n_threads, normalize=self._normalize)
             if batch is None:
                 fallback = list(range(len(indices)))
             else:

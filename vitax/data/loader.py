@@ -90,7 +90,9 @@ class ShardedLoader:
             images, labels = self.dataset.load_batch(indices, self.num_workers)
             return {"image": images, "label": labels}
         items = list(self._pool.map(self.dataset.__getitem__, indices))
-        images = np.stack([it[0] for it in items]).astype(np.float32)
+        images = np.stack([it[0] for it in items])
+        if images.dtype != np.uint8:  # uint8 = device-side normalization path
+            images = images.astype(np.float32)
         labels = np.asarray([it[1] for it in items], np.int32)
         return {"image": images, "label": labels}
 
@@ -168,10 +170,15 @@ def build_datasets(cfg: Config, mesh: Mesh):
         from vitax.data.imagefolder import ImageFolderDataset
         from vitax.data.transforms import train_transform, val_transform
         import os
+        # device_normalize: transforms emit raw uint8 and the jitted step
+        # normalizes on-device (step.py:prepare_images)
+        norm_on_host = not cfg.device_normalize
         train_ds = ImageFolderDataset(
-            os.path.join(cfg.data_dir, "train"), train_transform(cfg.image_size, cfg.seed))
+            os.path.join(cfg.data_dir, "train"),
+            train_transform(cfg.image_size, cfg.seed, normalize=norm_on_host))
         val_ds = ImageFolderDataset(
-            os.path.join(cfg.data_dir, "val"), val_transform(cfg.image_size))
+            os.path.join(cfg.data_dir, "val"),
+            val_transform(cfg.image_size, normalize=norm_on_host))
 
     train_sampler = ShardedSampler(len(train_ds), cfg.batch_size, shuffle=True, seed=cfg.seed)
     val_sampler = ShardedSampler(len(val_ds), cfg.batch_size, shuffle=False, seed=cfg.seed)

@@ -40,40 +40,46 @@ def jpeg_size(path: str) -> Optional[Tuple[int, int]]:
 
 
 def process_file(path: str, params: Sequence[int], out_size: int,
-                 resize_to: int) -> Optional[np.ndarray]:
+                 resize_to: int, normalize: bool = True) -> Optional[np.ndarray]:
     """Decode + transform one JPEG; params = (mode, left, top, cw, ch, flip)
-    from a transform's native_params(). Returns (S, S, 3) float32 or None."""
+    from a transform's native_params(). Returns (S, S, 3) float32 normalized
+    when `normalize`, else raw uint8 (device-side normalization path), or
+    None on failure."""
     lib = _native.load()
     if lib is None:
         return None
-    out = np.empty((out_size, out_size, 3), np.float32)
+    out = np.empty((out_size, out_size, 3),
+                   np.float32 if normalize else np.uint8)
     mode, left, top, cw, ch, flip = (int(x) for x in params)
     rc = lib.vitax_process_file(
         path.encode(), mode, left, top, cw, ch, flip, out_size, resize_to,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        int(normalize), out.ctypes.data_as(ctypes.c_void_p))
     return out if rc == 0 else None
 
 
 def process_batch(paths: Sequence[str], params: Sequence[Sequence[int]],
-                  out_size: int, resize_to: int, n_threads: int = 8
+                  out_size: int, resize_to: int, n_threads: int = 8,
+                  normalize: bool = True
                   ) -> Tuple[Optional[np.ndarray], List[int]]:
     """Decode + transform a batch on the C++ thread pool.
 
-    Returns (batch (N, S, S, 3) float32, failed_indices); failed slots are
-    untouched and must be filled by the caller's fallback path. Returns
-    (None, all indices) if the native library is unavailable.
+    Returns (batch (N, S, S, 3) float32-normalized or raw-uint8,
+    failed_indices); failed slots are untouched and must be filled by the
+    caller's fallback path. Returns (None, all indices) if the native library
+    is unavailable.
     """
     n = len(paths)
     if _native.load() is None:
         return None, list(range(n))
     lib = _native.load()
-    out = np.empty((n, out_size, out_size, 3), np.float32)
+    out = np.empty((n, out_size, out_size, 3),
+                   np.float32 if normalize else np.uint8)
     fail = np.zeros(n, np.uint8)
     params_arr = np.ascontiguousarray(params, np.int32).reshape(n, 6)
     c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
     lib.vitax_process_batch(
         c_paths, n, params_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        out_size, resize_to,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_size, resize_to, int(normalize),
+        out.ctypes.data_as(ctypes.c_void_p),
         fail.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n_threads)
     return out, list(np.nonzero(fail)[0])

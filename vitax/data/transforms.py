@@ -93,10 +93,13 @@ def resize_shorter(img: Image.Image, size: int) -> Image.Image:
 class TrainTransform:
     """Reference train stack (run_vit_training.py:39-46)."""
 
-    def __init__(self, image_size: int, seed: int = 0):
+    def __init__(self, image_size: int, seed: int = 0, normalize: bool = True):
         self.image_size = image_size
         self.seed = seed
         self.epoch = 0
+        # normalize=False emits raw uint8 (normalization happens on-device in
+        # the train step — 4x smaller host->device transfer)
+        self.normalize = normalize
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -107,6 +110,8 @@ class TrainTransform:
         img = random_resized_crop(img, self.image_size, rng)
         if rng.random() < 0.5:
             img = img.transpose(Image.Transpose.FLIP_LEFT_RIGHT)
+        if not self.normalize:
+            return np.asarray(img, np.uint8)
         return _to_normalized_array(img)
 
     def native_params(self, width: int, height: int, index: int):
@@ -124,9 +129,10 @@ class ValTransform:
     """Reference val stack (run_vit_training.py:48-55): resize shorter side to
     size*256//224, center crop."""
 
-    def __init__(self, image_size: int):
+    def __init__(self, image_size: int, normalize: bool = True):
         self.image_size = image_size
         self.resize_to = (image_size * 256) // 224
+        self.normalize = normalize
 
     def set_epoch(self, epoch: int) -> None:
         pass
@@ -134,15 +140,18 @@ class ValTransform:
     def __call__(self, img: Image.Image, index: int = 0) -> np.ndarray:
         img = resize_shorter(img, self.resize_to)
         img = center_crop(img, self.image_size)
+        if not self.normalize:
+            return np.asarray(img, np.uint8)
         return _to_normalized_array(img)
 
     def native_params(self, width: int, height: int, index: int):
         return (1, 0, 0, 0, 0, 0)  # val pipeline is parameter-free
 
 
-def train_transform(image_size: int, seed: int = 0) -> TrainTransform:
-    return TrainTransform(image_size, seed)
+def train_transform(image_size: int, seed: int = 0,
+                    normalize: bool = True) -> TrainTransform:
+    return TrainTransform(image_size, seed, normalize)
 
 
-def val_transform(image_size: int) -> ValTransform:
-    return ValTransform(image_size)
+def val_transform(image_size: int, normalize: bool = True) -> ValTransform:
+    return ValTransform(image_size, normalize)
