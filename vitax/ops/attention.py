@@ -10,9 +10,10 @@ recompute from the saved logsumexp).
 Design notes (see /opt/skills/guides/pallas_guide.md):
 - grid = (batch, heads); each program computes one head's full (N, Dh)
   attention with scores in VMEM. ViT sequence lengths are short (256 tokens at
-  224^2/patch 14), so whole-N blocks fit comfortably; the kernel is gated to
-  N <= MAX_SEQ_IN_VMEM and falls back to the dense path otherwise (long-sequence
-  scaling is handled by ring attention across chips, vitax/parallel/ring_attention.py).
+  224^2/patch 14), so whole-N blocks fit comfortably; beyond N = MAX_SEQ_IN_VMEM
+  the streaming kernel (vitax/ops/flash_blocked.py, VMEM-independent of N) takes
+  over, and ring attention handles cross-chip sequence sharding
+  (vitax/parallel/ring_attention.py).
 - logits accumulate in float32 on the MXU (preferred_element_type), softmax in
   float32, outputs cast back to the activation dtype.
 - Under a multi-device mesh the kernel runs inside shard_map: batch over
@@ -185,19 +186,24 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
 
     if not cfg.use_flash_attention:
         return None
-    if n > MAX_SEQ_IN_VMEM:
-        return None
     if jax.devices()[0].platform not in ("tpu",):
         return None
 
+    if n > MAX_SEQ_IN_VMEM:
+        # streaming kernel: VMEM use independent of N (vitax/ops/flash_blocked.py)
+        from vitax.ops.flash_blocked import blocked_flash_attention
+        kernel = blocked_flash_attention
+    else:
+        kernel = flash_attention
+
     if mesh is None or mesh.size == 1:
-        return flash_attention
+        return kernel
 
     if cfg.num_heads % tp != 0:
         return None
     spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
     return jax.shard_map(
-        flash_attention, mesh=mesh,
+        kernel, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
