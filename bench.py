@@ -55,7 +55,8 @@ def model_flops_per_image(cfg) -> float:
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--preset", default="l14", choices=["tiny", "l14", "10b"])
+    p.add_argument("--preset", default="l14",
+                   choices=["tiny", "b16", "l14", "10b"])
     p.add_argument("--batch_size", type=int, default=0)
     # default resolved per preset below: dots_saveable measured fastest on v5e
     # where activations fit (l14: 164.2 vs 155.8 img/s/chip); the 10B flagship
@@ -79,6 +80,9 @@ def main():
     presets = {
         "tiny": dict(image_size=224, patch_size=16, embed_dim=192, num_heads=3,
                      num_blocks=12, batch_size=64 * n_dev),
+        # BASELINE.json config 2 shape (ViT-B/16, pure-DP benchmark)
+        "b16": dict(image_size=224, patch_size=16, embed_dim=768, num_heads=12,
+                    num_blocks=12, batch_size=64 * n_dev),
         "l14": dict(image_size=224, patch_size=14, embed_dim=1024, num_heads=16,
                     num_blocks=24, batch_size=32 * n_dev),
         "10b": dict(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,

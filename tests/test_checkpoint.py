@@ -86,6 +86,26 @@ def test_resume_through_loop(devices8, tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+def test_auto_resume_latest(devices8, tmp_path):
+    """--resume_epoch -1 resumes from the newest complete checkpoint; with an
+    empty ckpt_dir it starts fresh (failure-recovery convenience beyond the
+    reference's manual epoch numbering, SURVEY.md section 5)."""
+    from vitax.train.loop import train
+    common = dict(
+        fake_data=True, steps_per_epoch=2, log_step_interval=10,
+        ckpt_dir=str(tmp_path / "ckpt"), ckpt_epoch_interval=1,
+        test_epoch_interval=99, num_workers=2, eval_max_batches=2,
+    )
+    # empty dir -> fresh start, trains both epochs
+    state = train(tiny_cfg(num_epochs=2, resume_epoch=-1, **common))
+    assert int(jax.device_get(state.step)) == 4
+    # now epoch_1 and epoch_2 exist -> auto-resume picks epoch 2 (no new steps)
+    state2 = train(tiny_cfg(num_epochs=2, resume_epoch=-1, **common))
+    assert int(jax.device_get(state2.step)) == 4
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(state2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
 def test_consolidate_export(devices8, tmp_path):
     cfg = tiny_cfg(ckpt_dir=str(tmp_path))
     _, state, _ = make_state(cfg)

@@ -1,0 +1,48 @@
+"""Launcher tests (xla_dist parity surface, reference README.md:94-119):
+remote command construction and the dry-run path — no gcloud needed."""
+
+import shlex
+
+from vitax.launch import RESTART_CMD, build_remote_command, main
+
+
+def test_build_remote_command_quotes_and_env():
+    remote = build_remote_command(
+        ["python3", "run_vit_training.py", "--data_dir", "/data/image net"],
+        env=["PYTHONUNBUFFERED=1", "XLA_FLAGS=--flag_a --flag_b"],
+        workdir="~/vitax")
+    assert remote.startswith("cd ~/vitax && ")
+    assert "export PYTHONUNBUFFERED=1;" in remote
+    assert "export 'XLA_FLAGS=--flag_a --flag_b';" in remote  # space -> quoted
+    assert "'/data/image net'" in remote  # spaces survive the remote shell
+
+
+def test_workdir_tilde_expansion_preserved():
+    assert build_remote_command(["true"], [], "~").startswith("cd ~ && ")
+    assert build_remote_command(["true"], [], "~/a b").startswith("cd ~/'a b' && ")
+    assert build_remote_command(["true"], [], "/opt/x").startswith("cd /opt/x && ")
+
+
+def test_restart_pattern_does_not_match_itself():
+    # the bracketed-first-char idiom: the pkill regex must not match the
+    # shell command carrying it, or the launcher kills its own SSH round
+    import re
+    pattern = "[r]un_vit_training.py"
+    assert pattern in RESTART_CMD
+    assert re.search(pattern, RESTART_CMD) is None
+
+
+def test_dry_run_prints_gcloud_command(capsys):
+    rc = main(["--tpu", "my-pod", "--zone", "us-central2-b", "--restart",
+               "--env", "PYTHONUNBUFFERED=1", "--dry_run",
+               "--", "python3", "run_vit_training.py", "--fake_data"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "launching:" in out
+    launch_line = [l for l in out.splitlines() if l.startswith("launching:")][0]
+    argv = shlex.split(launch_line[len("launching:"):])
+    assert argv[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "my-pod"]
+    assert "--worker=all" in argv
+    assert "--zone=us-central2-b" in argv
+    command = [a for a in argv if a.startswith("--command=")][0]
+    assert "run_vit_training.py" in command and "--fake_data" in command

@@ -26,7 +26,7 @@ class Config:
     fake_data: bool = False
     num_workers: int = 4
     ckpt_dir: str = "/tmp/vit_fsdp"
-    resume_epoch: int = 0
+    resume_epoch: int = 0               # N = resume from epoch N; -1 = auto-resume latest checkpoint
     ckpt_epoch_interval: int = 10
     test_epoch_interval: int = 10
     log_step_interval: int = 20

@@ -57,6 +57,8 @@ def main(argv=None):
                    help="kill stale training processes on all workers first")
     p.add_argument("--workdir", default="~/vitax")
     p.add_argument("--logfile", default=None)
+    p.add_argument("--dry_run", action="store_true",
+                   help="print the gcloud command(s) without executing")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- command to run on every worker")
     args = p.parse_args(argv)
@@ -77,12 +79,16 @@ def main(argv=None):
     if args.restart:
         # separate SSH round so the kill pattern cannot match (and terminate)
         # the shell carrying the training command itself
-        print("restarting: killing stale training processes on all workers", flush=True)
-        subprocess.call(gcloud_ssh(RESTART_CMD))
+        restart = gcloud_ssh(RESTART_CMD)
+        print("restarting: " + " ".join(shlex.quote(g) for g in restart), flush=True)
+        if not args.dry_run:
+            subprocess.call(restart)
 
     gcloud = gcloud_ssh(build_remote_command(cmd, args.env, args.workdir))
 
     print("launching:", " ".join(shlex.quote(g) for g in gcloud), flush=True)
+    if args.dry_run:
+        return 0
     if args.logfile:
         with open(args.logfile, "ab") as log:
             proc = subprocess.Popen(gcloud, stdout=subprocess.PIPE,

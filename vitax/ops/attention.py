@@ -165,6 +165,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return o.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
 
 
+def _named(fn, name: str):
+    """Tag an attention impl with a human-readable name for the startup log
+    (shard_map outputs don't take attribute assignment, so wrap)."""
+    def impl(q, k, v):
+        return fn(q, k, v)
+    impl.vitax_name = name
+    return impl
+
+
 def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     """Choose the attention core for this config/mesh:
 
@@ -182,7 +191,7 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
         if n % sp != 0 or cfg.num_heads % tp != 0:
             return None  # indivisible: let GSPMD handle the dense path
         from vitax.parallel.ring_attention import make_ring_attention
-        return make_ring_attention(mesh)
+        return _named(make_ring_attention(mesh), "ring attention (sp)")
 
     if not cfg.use_flash_attention:
         return None
@@ -192,18 +201,18 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     if n > MAX_SEQ_IN_VMEM:
         # streaming kernel: VMEM use independent of N (vitax/ops/flash_blocked.py)
         from vitax.ops.flash_blocked import blocked_flash_attention
-        kernel = blocked_flash_attention
+        kernel, name = blocked_flash_attention, "pallas streaming (blocked)"
     else:
-        kernel = flash_attention
+        kernel, name = flash_attention, "pallas fused (whole-N)"
 
     if mesh is None or mesh.size == 1:
-        return kernel
+        return _named(kernel, name)
 
     if cfg.num_heads % tp != 0:
         return None
     spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
-    return jax.shard_map(
+    return _named(jax.shard_map(
         kernel, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
-    )
+    ), name + " + shard_map")

@@ -56,6 +56,12 @@ def train(cfg: Config) -> TrainState:
     master_print(f"\n=== dataset ===\n{pprint.pformat(train_ds)}\n")
 
     # --- model + optimizer, born sharded (reference :228-242) ---
+    if cfg.resume_epoch < 0:  # auto-resume: latest complete checkpoint, if any
+        from vitax.checkpoint.orbax_io import latest_epoch
+        import dataclasses
+        found = latest_epoch(cfg.ckpt_dir) or 0
+        cfg = dataclasses.replace(cfg, resume_epoch=found)
+        master_print(f"auto-resume: {'epoch ' + str(found) if found else 'no checkpoint found, fresh start'}")
     model = build_model(cfg, attention_impl=attention_impl,
                         token_sharding=_token_sharding(cfg, mesh))
     steps_per_epoch = cfg.steps_per_epoch or (len(train_ds) // cfg.batch_size)
@@ -157,12 +163,13 @@ def _token_sharding(cfg: Config, mesh):
 
 
 def _select_attention(cfg: Config, mesh):
-    """Pick the attention core: fused Pallas kernel on TPU when shapes fit,
-    dense jnp path elsewhere (vitax.ops.attention.make_attention_impl)."""
+    """Pick the attention core (vitax.ops.attention.make_attention_impl):
+    ring attention under sp, whole-N or streaming Pallas kernel on TPU,
+    dense jnp elsewhere."""
     from vitax.ops.attention import make_attention_impl
     impl = make_attention_impl(cfg, mesh)
     master_print("attention core: "
-                 + ("pallas fused kernel" if impl is not None else "dense jnp"))
+                 + getattr(impl, "vitax_name", "dense jnp"))
     return impl
 
 
