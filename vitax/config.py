@@ -67,6 +67,7 @@ class Config:
     fsdp_size: int = -1
     tp_size: int = 1
     sp_size: int = 1
+    sp_impl: str = "ring"               # ring (ppermute K/V rotation) | ulysses (all-to-all head<->token)
     scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
     device_normalize: bool = True       # ship uint8 batches; normalize on-device (4x less host->device traffic)
     # none_saveable = the reference's checkpoint_module semantics (recompute
@@ -143,6 +144,8 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--fsdp_size", type=int, default=-1)
     ext.add_argument("--tp_size", type=int, default=1)
     ext.add_argument("--sp_size", type=int, default=1)
+    ext.add_argument("--sp_impl", type=str, default="ring",
+                     choices=["ring", "ulysses"])
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
     ext.add_argument("--host_normalize", action="store_false", dest="device_normalize")
     ext.add_argument("--remat_policy", type=str, default=Config.remat_policy,

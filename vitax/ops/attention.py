@@ -174,13 +174,29 @@ def _named(fn, name: str):
     return impl
 
 
+def _tpu_kernel(cfg, n: int):
+    """(kernel, name) for full-sequence attention on this platform, or
+    (None, None) when only the dense jnp path applies. The single source of
+    the use_flash_attention / platform / VMEM-threshold policy."""
+    if not cfg.use_flash_attention:
+        return None, None
+    if jax.devices()[0].platform != "tpu":
+        return None, None
+    if n > MAX_SEQ_IN_VMEM:
+        # streaming kernel: VMEM use independent of N (vitax/ops/flash_blocked.py)
+        from vitax.ops.flash_blocked import blocked_flash_attention
+        return blocked_flash_attention, "pallas streaming (blocked)"
+    return flash_attention, "pallas fused (whole-N)"
+
+
 def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     """Choose the attention core for this config/mesh:
 
-    - sp > 1: ring attention over the sequence axis (works on any backend —
-      the long-context path; vitax/parallel/ring_attention.py)
-    - TPU, shapes fit VMEM: the fused Pallas kernel (shard_map-wrapped on
-      multi-device meshes)
+    - sp > 1: sequence parallelism — ring attention (default), or Ulysses
+      all-to-all head<->token resharding with --sp_impl ulysses when
+      num_heads divides over sp*tp (vitax/parallel/{ring_attention,ulysses}.py)
+    - TPU: the whole-N fused Pallas kernel, or the streaming (blocked) kernel
+      beyond MAX_SEQ_IN_VMEM (shard_map-wrapped on multi-device meshes)
     - otherwise: None -> dense jnp path (GSPMD still shards batch/heads)
     """
     n = cfg.num_patches
@@ -190,20 +206,20 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     if sp > 1:
         if n % sp != 0 or cfg.num_heads % tp != 0:
             return None  # indivisible: let GSPMD handle the dense path
+        if (getattr(cfg, "sp_impl", "ring") == "ulysses"
+                and cfg.num_heads % (sp * tp) == 0):
+            # all-to-all head<->token resharding; the inner kernel sees the
+            # full sequence, so the Pallas cores apply on TPU
+            from vitax.parallel.ulysses import make_ulysses_attention
+            inner, _ = _tpu_kernel(cfg, n)
+            return _named(make_ulysses_attention(mesh, inner),
+                          "ulysses all-to-all (sp)")
         from vitax.parallel.ring_attention import make_ring_attention
         return _named(make_ring_attention(mesh), "ring attention (sp)")
 
-    if not cfg.use_flash_attention:
+    kernel, name = _tpu_kernel(cfg, n)
+    if kernel is None:
         return None
-    if jax.devices()[0].platform not in ("tpu",):
-        return None
-
-    if n > MAX_SEQ_IN_VMEM:
-        # streaming kernel: VMEM use independent of N (vitax/ops/flash_blocked.py)
-        from vitax.ops.flash_blocked import blocked_flash_attention
-        kernel, name = blocked_flash_attention, "pallas streaming (blocked)"
-    else:
-        kernel, name = flash_attention, "pallas fused (whole-N)"
 
     if mesh is None or mesh.size == 1:
         return _named(kernel, name)

@@ -1,0 +1,72 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->token resharding.
+
+The second sequence-parallel strategy alongside ring attention
+(vitax/parallel/ring_attention.py); both are capability beyond the reference,
+which has no sequence scaling at all (SURVEY.md section 5, 'long-context:
+absent'). Selected with --sp_impl ulysses.
+
+Scheme (DeepSpeed-Ulysses, arXiv:2309.14509): activations arrive sharded over
+the token axis ("sp"). One all-to-all converts token-sharded to head-sharded —
+each chip then holds ALL tokens for H/sp of the heads — attention runs locally
+(dense, or whole-N/streaming Pallas kernels on TPU since each chip sees the
+full sequence), and a second all-to-all restores token sharding.
+
+Trade-off vs ring: two all-to-alls move activations once each way (cheap on
+ICI's all-to-all bandwidth) and the inner attention is a plain local kernel
+(no per-step ppermute latency on the critical path), but head count must be
+divisible by sp * tp, and each chip must fit the full-sequence K/V for its
+head slice — ring keeps only O(N/sp) K/V resident. Heads shard over
+sp AND tp jointly here; batch stays on (dp, fsdp).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from vitax.ops.attention import reference_attention
+
+
+def _ulysses_local(q, k, v, inner: Callable, axis_name: str):
+    """shard_map body. q, k, v: (B, N/sp, H/tp, Dh) local shards.
+
+    all_to_all over sp: scatter the head axis, gather the token axis ->
+    (B, N, H/(tp*sp), Dh); local full-sequence attention; inverse all_to_all.
+    """
+    def a2a_in(x):   # (B, N/sp, H, Dh) -> (B, N, H/sp, Dh)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def a2a_out(x):  # (B, N, H/sp, Dh) -> (B, N/sp, H, Dh)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    return a2a_out(inner(a2a_in(q), a2a_in(k), a2a_in(v)))
+
+
+def make_ulysses_attention(mesh: Mesh, inner: Optional[Callable] = None,
+                           axis_name: str = "sp"):
+    """Build a (B, N, H, Dh) -> (B, N, H, Dh) attention core with tokens
+    sharded over `axis_name` outside, heads sharded over it inside.
+
+    `inner` computes full-sequence attention on the per-chip head slice
+    ((B, N, H_local, Dh) -> same); defaults to the dense jnp core. Requires
+    num_heads % (sp * tp) == 0 (checked by the caller,
+    vitax.ops.attention.make_attention_impl).
+    """
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    inner = inner if inner is not None else reference_attention
+
+    def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        fn = jax.shard_map(
+            functools.partial(_ulysses_local, inner=inner, axis_name=axis_name),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return ulysses_attention
