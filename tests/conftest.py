@@ -20,6 +20,11 @@ force_cpu_if_requested()
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: subprocess entry-point smoke tests (~30s each)")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

@@ -1,0 +1,55 @@
+"""Driver entry-point smoke tests: bench.py and __graft_entry__.py must keep
+working — the round's benchmark and compile checks run through them.
+
+Both run in subprocesses with JAX_PLATFORMS=cpu so the forced-platform guard
+(vitax/platform.py) is exercised exactly as the driver exercises it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, extra_env=None, timeout=600):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.update(extra_env or {})
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+@pytest.mark.slow
+def test_bench_prints_one_json_line():
+    r = _run([sys.executable, "bench.py", "--preset", "tiny", "--batch_size", "8",
+              "--steps", "2", "--warmup", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+    assert result["unit"] == "images/sec/chip"
+    assert result["value"] > 0
+
+
+@pytest.mark.slow
+def test_graft_dryrun_multichip():
+    r = _run([sys.executable, "-c",
+              "import __graft_entry__ as g; g.dryrun_multichip(8)"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dryrun_multichip ok" in r.stdout
+
+
+@pytest.mark.slow
+def test_graft_entry_compiles_single_chip():
+    r = _run([sys.executable, "-c", (
+        "import jax, __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn).lower(*args).compile()(*args)\n"
+        "print('entry ok', out.shape)\n")],
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "entry ok" in r.stdout

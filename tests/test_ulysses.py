@@ -54,6 +54,32 @@ def test_ulysses_grad_matches_dense(devices8):
                                    rtol=1e-3, atol=1e-3)
 
 
+def test_ulysses_with_flash_inner(devices8):
+    """The production TPU composition — the Pallas flash kernel running inside
+    the ulysses shard_map on a head slice — in interpret mode on CPU."""
+    from vitax.ops.attention import flash_attention
+    mesh = build_mesh(sp_cfg())
+    ulysses = make_ulysses_attention(mesh, inner=flash_attention)
+    b, n, h, dh = 2, 16, 4, 8
+    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(kq, (b, n, h, dh), jnp.float32)
+    k = jax.random.normal(kk, (b, n, h, dh), jnp.float32)
+    v = jax.random.normal(kv, (b, n, h, dh), jnp.float32)
+    out = jax.jit(ulysses)(q, k, v)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    got = jax.jit(jax.grad(loss(ulysses), argnums=(0, 1, 2)))(q, k, v)
+    want = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3)
+
+
 def test_selector_routes_by_sp_impl(devices8):
     mesh = build_mesh(sp_cfg())
     impl = make_attention_impl(sp_cfg(), mesh)

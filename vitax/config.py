@@ -95,6 +95,8 @@ class Config:
             f"image_size {self.image_size} not divisible by patch_size {self.patch_size}")
         assert self.embed_dim % self.num_heads == 0, (
             f"embed_dim {self.embed_dim} not divisible by num_heads {self.num_heads}")
+        assert self.sp_impl in ("ring", "ulysses"), (
+            f"unknown sp_impl {self.sp_impl!r} (expected 'ring' or 'ulysses')")
         return self
 
 

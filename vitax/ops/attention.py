@@ -206,14 +206,19 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     if sp > 1:
         if n % sp != 0 or cfg.num_heads % tp != 0:
             return None  # indivisible: let GSPMD handle the dense path
-        if (getattr(cfg, "sp_impl", "ring") == "ulysses"
-                and cfg.num_heads % (sp * tp) == 0):
-            # all-to-all head<->token resharding; the inner kernel sees the
-            # full sequence, so the Pallas cores apply on TPU
-            from vitax.parallel.ulysses import make_ulysses_attention
-            inner, _ = _tpu_kernel(cfg, n)
-            return _named(make_ulysses_attention(mesh, inner),
-                          "ulysses all-to-all (sp)")
+        if getattr(cfg, "sp_impl", "ring") == "ulysses":
+            if cfg.num_heads % (sp * tp) == 0:
+                # all-to-all head<->token resharding; the inner kernel sees
+                # the full sequence, so the Pallas cores apply on TPU
+                from vitax.parallel.ulysses import make_ulysses_attention
+                inner, _ = _tpu_kernel(cfg, n)
+                return _named(make_ulysses_attention(mesh, inner),
+                              "ulysses all-to-all (sp)")
+            from vitax.utils.logging import master_print
+            master_print(
+                f"WARNING: --sp_impl ulysses needs num_heads divisible by "
+                f"sp*tp ({cfg.num_heads} % {sp * tp} != 0); falling back to "
+                f"ring attention")
         from vitax.parallel.ring_attention import make_ring_attention
         return _named(make_ring_attention(mesh), "ring attention (sp)")
 

@@ -36,16 +36,15 @@ def _ulysses_local(q, k, v, inner: Callable, axis_name: str):
 
     all_to_all over sp: scatter the head axis, gather the token axis ->
     (B, N, H/(tp*sp), Dh); local full-sequence attention; inverse all_to_all.
+    q/k/v are stacked so the inbound reshard is ONE collective, not three
+    (XLA does not reliably merge distinct all-to-alls).
     """
-    def a2a_in(x):   # (B, N/sp, H, Dh) -> (B, N, H/sp, Dh)
-        return jax.lax.all_to_all(
-            x, axis_name, split_axis=2, concat_axis=1, tiled=True)
-
-    def a2a_out(x):  # (B, N, H/sp, Dh) -> (B, N/sp, H, Dh)
-        return jax.lax.all_to_all(
-            x, axis_name, split_axis=1, concat_axis=2, tiled=True)
-
-    return a2a_out(inner(a2a_in(q), a2a_in(k), a2a_in(v)))
+    qkv = jnp.stack([q, k, v])  # (3, B, N/sp, H, Dh)
+    qkv = jax.lax.all_to_all(
+        qkv, axis_name, split_axis=3, concat_axis=2, tiled=True)
+    o = inner(qkv[0], qkv[1], qkv[2])
+    return jax.lax.all_to_all(  # (B, N, H/sp, Dh) -> (B, N/sp, H, Dh)
+        o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
 def make_ulysses_attention(mesh: Mesh, inner: Optional[Callable] = None,
