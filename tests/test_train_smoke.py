@@ -58,6 +58,22 @@ def run_steps(cfg, n_steps=8, seed=0):
     return state, losses
 
 
+def test_profile_trace_written(devices8, tmp_path):
+    """--profile_dir captures a jax.profiler trace of steps 3-7 through the
+    full loop (SURVEY.md section 5, tracing/profiling subsystem)."""
+    import os
+    from vitax.train.loop import train
+    prof_dir = str(tmp_path / "trace")
+    # the final-epoch save/eval clause still fires on num_epochs=1 — cap eval
+    train(tiny_cfg(fake_data=True, num_epochs=1, steps_per_epoch=8,
+                   profile_dir=prof_dir, log_step_interval=10,
+                   ckpt_dir=str(tmp_path / "ckpt"), ckpt_epoch_interval=99,
+                   test_epoch_interval=99, num_workers=2, eval_max_batches=1))
+    found = [os.path.join(dp, f) for dp, _, fs in os.walk(prof_dir) for f in fs]
+    assert any(f.endswith((".pb", ".json.gz", ".trace.json.gz")) for f in found), (
+        f"no trace artifacts under {prof_dir}: {found}")
+
+
 def test_fsdp_loss_decreases(devices8):
     _, losses = run_steps(tiny_cfg(), n_steps=10)
     assert all(np.isfinite(losses))

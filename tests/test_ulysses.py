@@ -6,6 +6,7 @@ ring-attention suite (tests/test_ring_attention.py) for --sp_impl ulysses."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from vitax.config import Config
 from vitax.ops.attention import make_attention_impl, reference_attention
@@ -21,9 +22,18 @@ def sp_cfg(**kw):
     return Config(**base).validate()
 
 
-def test_ulysses_matches_dense(devices8):
+def _inner_impls():
+    from vitax.ops.attention import flash_attention
+    # None = dense reference inner; flash = the production TPU composition
+    # (Pallas kernel inside the ulysses shard_map), interpret mode on CPU
+    return [pytest.param(None, id="dense"),
+            pytest.param(flash_attention, id="flash")]
+
+
+@pytest.mark.parametrize("inner", _inner_impls())
+def test_ulysses_matches_dense(devices8, inner):
     mesh = build_mesh(sp_cfg())  # dp1 x fsdp2 x tp1 x sp4
-    ulysses = make_ulysses_attention(mesh)
+    ulysses = make_ulysses_attention(mesh, inner=inner)
     b, n, h, dh = 4, 16, 4, 8  # h % sp == 0
     kq, kk, kv = jax.random.split(jax.random.key(0), 3)
     q = jax.random.normal(kq, (b, n, h, dh), jnp.float32)
@@ -35,9 +45,10 @@ def test_ulysses_matches_dense(devices8):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_ulysses_grad_matches_dense(devices8):
+@pytest.mark.parametrize("inner", _inner_impls())
+def test_ulysses_grad_matches_dense(devices8, inner):
     mesh = build_mesh(sp_cfg())
-    ulysses = make_ulysses_attention(mesh)
+    ulysses = make_ulysses_attention(mesh, inner=inner)
     shape = (2, 16, 4, 8)
     kq, kk, kv = jax.random.split(jax.random.key(1), 3)
     q = jax.random.normal(kq, shape, jnp.float32)
@@ -51,32 +62,6 @@ def test_ulysses_grad_matches_dense(devices8):
     want = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-3, atol=1e-3)
-
-
-def test_ulysses_with_flash_inner(devices8):
-    """The production TPU composition — the Pallas flash kernel running inside
-    the ulysses shard_map on a head slice — in interpret mode on CPU."""
-    from vitax.ops.attention import flash_attention
-    mesh = build_mesh(sp_cfg())
-    ulysses = make_ulysses_attention(mesh, inner=flash_attention)
-    b, n, h, dh = 2, 16, 4, 8
-    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
-    q = jax.random.normal(kq, (b, n, h, dh), jnp.float32)
-    k = jax.random.normal(kk, (b, n, h, dh), jnp.float32)
-    v = jax.random.normal(kv, (b, n, h, dh), jnp.float32)
-    out = jax.jit(ulysses)(q, k, v)
-    ref = reference_attention(q, k, v)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
-
-    def loss(fn):
-        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
-
-    got = jax.jit(jax.grad(loss(ulysses), argnums=(0, 1, 2)))(q, k, v)
-    want = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
-    for a, b_ in zip(got, want):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-3, atol=1e-3)
 
 

@@ -1,0 +1,14 @@
+"""Explicit build entry: `python -m vitax._native` compiles the data-path
+library ahead of time (it otherwise builds lazily on first use). Exit 0 on
+success, 1 if the toolchain/libjpeg is unavailable."""
+
+import sys
+
+from vitax import _native
+
+if __name__ == "__main__":
+    lib = _native.load()
+    if lib is None:
+        print("native library unavailable (g++ or libjpeg missing)", file=sys.stderr)
+        sys.exit(1)
+    print(f"native library ready: {_native._SO}")
