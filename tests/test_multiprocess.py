@@ -1,0 +1,78 @@
+"""True multi-process distributed training test: 2 processes x 4 CPU devices.
+
+Exercises the control plane nothing else touches — jax.distributed.initialize
+via the explicit env bring-up (vitax/distributed.py:maybe_initialize), the
+named barriers, per-process data sharding (ShardedSampler with
+process_count=2), global-batch assembly via make_array_from_process_local_data,
+and cross-process Gloo collectives inside the compiled step. This is the
+multi-host capability the reference gets from xla_dist + the XRT mesh service
+(reference README.md:99-101; SURVEY.md section 2.4), validated without TPUs.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "run_vit_training.py", "--fake_data",
+             "--image_size", "32", "--patch_size", "8", "--embed_dim", "32",
+             "--num_heads", "2", "--num_blocks", "2", "--num_classes", "4",
+             "--batch_size", "16", "--dtype", "float32", "--num_epochs", "1",
+             "--steps_per_epoch", "3", "--log_step_interval", "1",
+             "--warmup_steps", "0", "--eval_max_batches", "1",
+             "--test_epoch_interval", "99", "--ckpt_epoch_interval", "99",
+             "--ckpt_dir", str(tmp_path / "ckpt")],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
+    finally:
+        for p in procs:  # no orphans on timeout/assert (e.g. a wedged barrier)
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+    # rank 0 logs; the loop must have seen 2 processes and 8 global devices
+    log = outs[0]
+    assert "(2 host(s))" in log, log[-2000:]
+    assert "over 8 devices" in log, log[-2000:]
+    assert "training completed" in log
+    # rank 1 stays quiet (master_print) but must also complete
+    assert "training completed" not in outs[1]
+
+    # the logged loss is the global-batch mean reduced across processes —
+    # grab the last step's loss and check it is finite
+    losses = re.findall(r"loss: ([0-9.]+)", log)
+    assert losses, log[-2000:]
+    assert all(float(x) > 0 for x in losses)

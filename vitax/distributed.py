@@ -31,13 +31,26 @@ def maybe_initialize() -> None:
     global _initialized
     if _initialized:
         return
-    # Multi-host only: TPU pods expose worker topology via env/metadata.
-    in_pod = (
-        int(os.environ.get("TPU_WORKER_COUNT", "1")) > 1
-        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
-        or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    )
-    if in_pod:
+    # Explicit bring-up: JAX_COORDINATOR_ADDRESS + JAX_NUM_PROCESSES +
+    # JAX_PROCESS_ID work on any transport (CPU clusters, tests — jax's
+    # auto-detection only covers SLURM/MPI/TPU-metadata/K8s).
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if coord:
+        if not (nproc or "").isdigit() or not (pid or "").isdigit():
+            raise ValueError(
+                "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES="
+                f"{nproc!r} / JAX_PROCESS_ID={pid!r} are missing or not "
+                "integers — all three are required for explicit multi-process "
+                "bring-up (otherwise every process would silently train "
+                "standalone on the full dataset)")
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(nproc),
+                                   process_id=int(pid))
+    elif (int(os.environ.get("TPU_WORKER_COUNT", "1")) > 1
+          or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")):
+        # TPU pod: worker topology comes from env/metadata.
         jax.distributed.initialize()
     _initialized = True
 
