@@ -140,13 +140,26 @@ def state_specs_like(abstract_state: PyTree, params_specs: PyTree) -> PyTree:
         names = _path_names(path)
         for marker in ("mu", "nu", "params"):
             if marker in names:
+                # exact-path match: the subpath after the marker must name a
+                # parameter (mu/nu ARE param-shaped trees; `params` in the
+                # state is the param tree itself). Suffix matching is a
+                # silent-misplacement landmine with colliding leaf names.
                 sub = names[names.index(marker) + 1:]
-                # match the param subpath suffix
-                for pnames, spec in flat_specs.items():
-                    if pnames[-len(sub):] == sub if sub else False:
-                        if len(leaf.shape) == len(spec):
-                            return spec
-                break
+                spec = flat_specs.get(sub)
+                if spec is None:
+                    raise ValueError(
+                        f"state leaf {'/'.join(names)}: no parameter at "
+                        f"subpath {'/'.join(sub) or '<root>'} — cannot infer "
+                        "its sharding (new optimizer state needs an explicit "
+                        "rule here)")
+                if len(leaf.shape) != len(spec):
+                    raise ValueError(
+                        f"state leaf {'/'.join(names)} has rank "
+                        f"{len(leaf.shape)} but the parameter spec at "
+                        f"{'/'.join(sub)} is rank {len(spec)} — non-param-"
+                        "shaped aux state (e.g. factored moments) needs an "
+                        "explicit sharding rule")
+                return spec
         return P()
 
     return jax.tree_util.tree_map_with_path(assign, abstract_state)
