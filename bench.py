@@ -63,7 +63,7 @@ def read_baseline() -> dict:
 def write_baseline(preset: str, entry: dict) -> None:
     base = read_baseline()
     base[preset] = entry
-    tmp = BASELINE_FILE + ".tmp"
+    tmp = BASELINE_FILE + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:  # tmp+rename: a watchdog os._exit mid-write
         json.dump(base, f, indent=2, sort_keys=True)  # must not truncate the
         f.write("\n")                                 # accumulated baselines
@@ -147,6 +147,12 @@ def bench_data_pipeline(args) -> None:
     from vitax.data.imagefolder import ImageFolderDataset
     from vitax.data.transforms import train_transform
 
+    if not _native_available():
+        emit_error("host data pipeline images/sec (native C++ decode+augment)",
+                   "native library unavailable (C++ toolchain missing or "
+                   "build failed)", unit="images/sec")
+        return
+
     rng = np.random.default_rng(0)
     n_images = args.data_images
     batch = args.batch_size or 256
@@ -173,11 +179,6 @@ def bench_data_pipeline(args) -> None:
                 ds.load_batch(idx, n_threads=args.data_threads)
             return batch * reps / (time.perf_counter() - t0)
 
-        if not _native_available():
-            emit_error("host data pipeline images/sec (native C++ decode+augment)",
-                       "native library unavailable (C++ toolchain missing or "
-                       "build failed)", unit="images/sec")
-            return
         native_ips = run(True)
         pil_ips = run(False)
 

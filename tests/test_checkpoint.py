@@ -40,7 +40,7 @@ def abstract_of(state, mesh, sspecs):
 def test_round_trip(devices8, tmp_path):
     cfg = tiny_cfg(ckpt_dir=str(tmp_path))
     mesh, state, sspecs = make_state(cfg)
-    save_state(cfg.ckpt_dir, 1, state)
+    save_state(cfg.ckpt_dir, 1, state, wait=True)
     assert latest_epoch(cfg.ckpt_dir) == 1
     restored = restore_state(cfg.ckpt_dir, 1, abstract_of(state, mesh, sspecs))
     for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
@@ -104,6 +104,61 @@ def test_auto_resume_latest(devices8, tmp_path):
     assert int(jax.device_get(state2.step)) == 4
     for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(state2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_async_save_does_not_block(devices8, tmp_path, monkeypatch):
+    """save_state (wait=False) must NOT drain the background write — the whole
+    point is that the commit overlaps the next epoch's training (VERDICT
+    round-1 item 4)."""
+    from vitax.checkpoint import orbax_io
+
+    cfg = tiny_cfg(ckpt_dir=str(tmp_path))
+    _, state, _ = make_state(cfg)
+    ckptr = orbax_io._checkpointer()
+    # orbax's save() legitimately drains the PREVIOUS save before starting a
+    # new one; what must NOT happen is a drain after this save's background
+    # commit starts — so track the event order
+    events = []
+    orig_wait = ckptr.wait_until_finished
+    monkeypatch.setattr(ckptr, "wait_until_finished",
+                        lambda: (events.append("wait"), orig_wait())[1])
+    mgr = ckptr._async_manager
+    orig_start = mgr.start_async_commit
+    monkeypatch.setattr(
+        mgr, "start_async_commit",
+        lambda *a, **k: (events.append("commit"), orig_start(*a, **k))[1])
+    save_state(cfg.ckpt_dir, 1, state)
+    assert "commit" in events, "save did not go through the async commit path"
+    assert "wait" not in events[events.index("commit"):], (
+        "async save_state drained its own write before returning")
+    orbax_io.wait_until_finished()
+    assert events[-1] == "wait" and latest_epoch(cfg.ckpt_dir) == 1
+
+
+def test_async_save_overlaps_training_and_snapshots_values(devices8, tmp_path):
+    """A save in flight must (a) coexist with further jitted train steps and
+    (b) have snapshotted the state values at save time — later updates to the
+    (potentially donated) buffers must not leak into the checkpoint."""
+    cfg = tiny_cfg(ckpt_dir=str(tmp_path))
+    mesh, state, sspecs = make_state(cfg)
+    saved_qkv = np.asarray(state.params["params"]["blocks"]["attn"]["qkv"]["kernel"])
+
+    save_state(cfg.ckpt_dir, 7, state)  # async, returns immediately
+
+    # training continues while the write commits; donation reuses the buffers
+    bump = jax.jit(
+        lambda s: s.replace(step=s.step + 1,
+                            params=jax.tree.map(lambda x: x * 2.0, s.params)),
+        donate_argnums=(0,))
+    for _ in range(3):
+        state = bump(state)
+    assert int(jax.device_get(state.step)) == 3
+
+    restored = restore_state(cfg.ckpt_dir, 7, abstract_of(state, mesh, sspecs))
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["params"]["blocks"]["attn"]["qkv"]["kernel"]),
+        saved_qkv)  # values from save time, not the x8 post-update buffers
+    assert int(jax.device_get(restored.step)) == 0
 
 
 def test_consolidate_export(devices8, tmp_path):

@@ -32,6 +32,53 @@ def test_restart_pattern_does_not_match_itself():
     assert re.search(pattern, RESTART_CMD) is None
 
 
+def test_restart_on_failure_relaunches_with_kill_round(monkeypatch, capsys):
+    """A nonzero worker exit must trigger a kill-stale round + relaunch, up to
+    --max_restarts times (xla_dist restart-on-failure parity); the retry must
+    succeed without exhausting the budget."""
+    import vitax.launch as launch
+
+    launches = []
+    restarts = []
+    monkeypatch.setattr(launch, "_run_launch",
+                        lambda gcloud, logfile: 1 if not launches.append(gcloud)
+                        and len(launches) == 1 else 0)
+    monkeypatch.setattr(launch.subprocess, "call",
+                        lambda argv: restarts.append(argv) or 0)
+    rc = main(["--tpu", "my-pod", "--max_restarts", "3",
+               "--", "python3", "run_vit_training.py"])
+    assert rc == 0
+    assert len(launches) == 2          # failed once, relaunched once
+    assert len(restarts) == 1          # kill-stale round before the relaunch
+    assert RESTART_CMD in " ".join(restarts[0])
+    out = capsys.readouterr().out
+    assert "worker exited with rc=1" in out
+
+
+def test_restart_budget_exhausted_returns_failure(monkeypatch, capsys):
+    import vitax.launch as launch
+
+    calls = []
+    monkeypatch.setattr(launch, "_run_launch",
+                        lambda gcloud, logfile: calls.append(1) or 7)
+    monkeypatch.setattr(launch.subprocess, "call", lambda argv: 0)
+    rc = main(["--tpu", "my-pod", "--max_restarts", "2", "--", "python3", "x.py"])
+    assert rc == 7
+    assert len(calls) == 3             # initial + 2 restarts
+    assert "giving up" in capsys.readouterr().out
+
+
+def test_max_restarts_zero_disables_retry(monkeypatch):
+    import vitax.launch as launch
+
+    calls = []
+    monkeypatch.setattr(launch, "_run_launch",
+                        lambda gcloud, logfile: calls.append(1) or 3)
+    monkeypatch.setattr(launch.subprocess, "call", lambda argv: 0)
+    rc = main(["--tpu", "my-pod", "--max_restarts", "0", "--", "python3", "x.py"])
+    assert rc == 3 and len(calls) == 1
+
+
 def test_dry_run_prints_gcloud_command(capsys):
     rc = main(["--tpu", "my-pod", "--zone", "us-central2-b", "--restart",
                "--env", "PYTHONUNBUFFERED=1", "--dry_run",

@@ -1,0 +1,168 @@
+"""HLO-level proof of ZeRO-3 memory behavior (VERDICT round-1 item 2).
+
+The whole scan+GSPMD design bets that XLA keeps per-layer all-gathers INSIDE
+the scan's while loop instead of hoisting a full-model gather before it — the
+property nested FSDP wrapping guarantees by construction in the reference
+(run_vit_training.py:177-181; SURVEY.md section 7 hard-part #2). These tests
+discharge that bet from the compiled (optimized, SPMD-partitioned) HLO of the
+real ViT-L/14 train step on the 8-device mesh:
+
+1. per-device argument memory is shard-bound (== global state / 8);
+2. transient (temp) memory is far below full-model size — no hoisted gather;
+3. every all-gather's output is per-layer/activation sized, never the stacked
+   24-block parameter tensor;
+4. the block-weight all-gathers carry `while/body` scope metadata in both the
+   forward and the rematted backward scan — they run once per layer step,
+   inside the loop.
+
+Plus a 10B-shape (BASELINE config 4) eval_shape + AOT lowering smoke: the
+flagship config traces and lowers without materializing anything.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from vitax.config import Config
+from vitax.models import build_model, count_params
+from vitax.parallel.mesh import batch_pspec, build_mesh
+from vitax.train.state import build_optimizer, make_train_state
+from vitax.train.step import make_train_step
+
+
+def _lower_train_step(cfg, n_steps_sched=100):
+    mesh = build_mesh(cfg)
+    model = build_model(cfg)
+    tx, _ = build_optimizer(cfg, max_iteration=n_steps_sched)
+    state, sspecs, _ = make_train_state(
+        cfg, model, tx, mesh, jax.random.key(0), materialize=False)
+    step = make_train_step(cfg, model, tx, mesh, sspecs)
+    sh = NamedSharding(mesh, batch_pspec())
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.image_size, cfg.image_size, 3),
+            jnp.float32, sharding=sh),
+        "label": jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32, sharding=sh),
+    }
+    return state, step.lower(state, batch, jax.random.key(0))
+
+
+def _state_bytes(abstract_state) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(abstract_state))
+
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
+                "u8": 1, "s8": 1, "f64": 8, "s64": 8, "u64": 8}
+
+
+def _shape_bytes(shape_str: str) -> int:
+    m = re.match(r"(\w+)\[([\d,]*)\]", shape_str)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dt, 4)
+
+
+@pytest.fixture(scope="module")
+def l14(devices8):
+    """Compiled ViT-L/14 FSDP train step (the BASELINE config-3 shape) on the
+    8-device mesh, with its abstract state."""
+    cfg = Config(image_size=224, patch_size=14, embed_dim=1024, num_heads=16,
+                 num_blocks=24, num_classes=1000, batch_size=8,
+                 warmup_steps=0).validate()
+    state, lowered = _lower_train_step(cfg)
+    compiled = lowered.compile()
+    return cfg, state, compiled
+
+
+def test_per_device_state_is_shard_bound(l14):
+    """Each device's input (params + both AdamW moments + batch shard) must be
+    ~1/8 of the global state — ZeRO-1/2/3 all hold simultaneously."""
+    cfg, state, compiled = l14
+    ma = compiled.memory_analysis()
+    global_bytes = _state_bytes(state)
+    batch_bytes = cfg.batch_size * cfg.image_size ** 2 * 3 * 4
+    bound = global_bytes / 8 + batch_bytes
+    assert ma.argument_size_in_bytes < bound * 1.10, (
+        f"per-device args {ma.argument_size_in_bytes/1e6:.0f} MB exceed the "
+        f"shard-bound {bound/1e6:.0f} MB — state is not fully sharded")
+
+
+def test_temp_memory_is_not_model_bound(l14):
+    """Transient memory must stay far below the full parameter tensor: a
+    hoisted whole-model all-gather would show up here at >= 1.2 GB."""
+    cfg, state, compiled = l14
+    ma = compiled.memory_analysis()
+    full_param_bytes = count_params_bytes(cfg)
+    assert ma.temp_size_in_bytes < 0.5 * full_param_bytes, (
+        f"temp {ma.temp_size_in_bytes/1e6:.0f} MB vs full params "
+        f"{full_param_bytes/1e6:.0f} MB — looks like a hoisted full gather")
+
+
+def count_params_bytes(cfg) -> int:
+    from vitax.models.vit import expected_param_count
+    return expected_param_count(cfg) * 4  # f32 master params
+
+
+def test_no_all_gather_is_stack_sized(l14):
+    """Every all-gather output must be per-layer/per-activation sized; the
+    stacked (24, ...) block parameters must never be gathered whole."""
+    cfg, state, compiled = l14
+    txt = compiled.as_text()
+    ags = re.findall(r"= (\S+) all-gather\(", txt)
+    assert ags, "no all-gathers found — sharding did not engage"
+    # largest legitimate gather: one layer's fc weights gathered as activations
+    # (B, N, mlp_hidden) f32 = 8*256*4096*4 = 33.5 MB; the stacked fc1 kernel
+    # would be 24*1024*4096*4 = 402 MB
+    per_layer_bound = 64 * 1024 * 1024
+    sizes = sorted((_shape_bytes(s) for s in ags), reverse=True)
+    assert sizes[0] < per_layer_bound, (
+        f"largest all-gather is {sizes[0]/1e6:.0f} MB — full-stack gather "
+        "(ZeRO-3 memory bet violated)")
+
+
+def test_block_all_gathers_are_inside_scan_loop(l14):
+    """XLA preserves source scope in op_name metadata: the block-weight
+    gathers must carry `while/body` scope in BOTH the forward scan and the
+    rematted backward scan, and every gather outside a while body must be a
+    non-block (patchify / pos-embed / head / batch) tensor."""
+    cfg, state, compiled = l14
+    txt = compiled.as_text()
+    ag_lines = [l for l in txt.splitlines() if re.search(r"= \S+ all-gather\(", l)]
+    scoped = []
+    for line in ag_lines:
+        m = re.search(r'op_name="([^"]*)"', line)
+        scoped.append(m.group(1) if m else "")
+    fwd_in_loop = [s for s in scoped
+                   if "while/body" in s and "transpose" not in s and "blocks" in s]
+    bwd_in_loop = [s for s in scoped
+                   if "while/body" in s and "transpose" in s and "blocks" in s]
+    outside = [s for s in scoped if "while/body" not in s]
+    assert fwd_in_loop, f"no forward in-loop block gathers; scopes: {scoped}"
+    assert bwd_in_loop, f"no backward in-loop block gathers; scopes: {scoped}"
+    for s in outside:
+        assert "blocks" not in s, (
+            f"block-parameter all-gather hoisted out of the scan loop: {s}")
+
+
+@pytest.mark.slow
+def test_10b_shape_traces_and_lowers(devices8):
+    """BASELINE config 4 (the 10.078B flagship): eval_shape the sharded state
+    and AOT-lower the full train step — no array is ever materialized, proving
+    the 10B path is traceable end-to-end on any host."""
+    cfg = Config(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
+                 num_blocks=32, num_classes=1000, batch_size=8,
+                 warmup_steps=0).validate()
+    state, lowered = _lower_train_step(cfg)
+    from vitax.models.vit import expected_param_count
+    n = sum(x.size for x in jax.tree.leaves(state.params))
+    assert n == expected_param_count(cfg) == 10_077_917_160
+    txt = lowered.as_text()
+    assert "stablehlo.while" in txt  # the 32-block scan survived lowering

@@ -54,6 +54,46 @@ def test_ring_grad_matches_dense(devices8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
 
 
+def test_ring_kernel_block_matches_dense(devices8):
+    """Pallas block product path (interpret mode on CPU): numerics + grads must
+    match the dense reference — this is the path real TPU SP training takes."""
+    cfg = sp_cfg()
+    mesh = build_mesh(cfg)
+    ring = make_ring_attention(mesh, use_kernel=True)
+    shape = (2, 16, 2, 8)
+    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(ring)(q, k, v)),
+        np.asarray(reference_attention(q, k, v)), rtol=2e-4, atol=2e-4)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    gr_ring = jax.jit(jax.grad(loss(ring), argnums=(0, 1, 2)))(q, k, v)
+    gr_ref = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr_ring, gr_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_ring_issues_exactly_sp_minus_one_permutes(devices8):
+    """The K/V rotation must run sp-1 times per tensor (the last block needs no
+    next-block fetch) and be visible as individually schedulable (unrolled)
+    collective-permutes — VERDICT round-1 item 3. sp=4 here: expect
+    2*(sp-1) = 6 permutes in the forward HLO, not 2*sp = 8."""
+    cfg = sp_cfg()
+    mesh = build_mesh(cfg)  # dp1 x fsdp2 x tp1 x sp4
+    ring = make_ring_attention(mesh)
+    shape = (2, 16, 2, 8)
+    q = jnp.ones(shape, jnp.float32)
+    hlo = jax.jit(ring).lower(q, q, q).as_text()
+    n_permutes = hlo.count("collective_permute")
+    assert n_permutes == 6, f"expected 6 collective_permutes (2 tensors x sp-1), got {n_permutes}"
+
+
 def test_sequence_parallel_train_step_equivalence(devices8):
     """Full train step with sp=4 must match the sp=1 FSDP trajectory — sequence
     parallelism must not change the math."""

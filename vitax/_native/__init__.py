@@ -26,11 +26,20 @@ _failed = False
 
 def _build() -> None:
     tmp = _SO + f".tmp{os.getpid()}"
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-        _SRC, "-o", tmp, "-ljpeg", "-pthread",
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
+    # -march=x86-64-v2, not native: the cache can live on a filesystem shared
+    # by heterogeneous workers, and a newer-ISA host's build would SIGILL the
+    # older hosts (v2 = SSE4.2/POPCNT, safe on any TPU-VM fleet; non-x86
+    # falls back to the compiler default)
+    march = ["-march=x86-64-v2"] if os.uname().machine in ("x86_64", "amd64") else []
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            _SRC, "-o", tmp, "-ljpeg", "-pthread"]
+    try:
+        subprocess.run(base[:2] + march + base[2:], check=True, capture_output=True)
+    except subprocess.CalledProcessError:
+        if not march:
+            raise
+        # GCC < 11 doesn't know x86-64-v2; plain x86-64 is still ISA-safe
+        subprocess.run(base, check=True, capture_output=True)
     os.replace(tmp, _SO)  # atomic: concurrent builders race benignly
 
 
@@ -63,11 +72,24 @@ def load() -> Optional[ctypes.CDLL]:
         try:
             if (not os.path.exists(_SO)
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _log("vitax native data path: compiling decode.cc (one-time, "
+                     "a few seconds; pre-build with `python -m vitax._native`)")
                 _build()
             _lib = _prototype(ctypes.CDLL(_SO))
-        except Exception:
+        except Exception as e:
+            _log(f"vitax native data path unavailable ({type(e).__name__}); "
+                 "falling back to the slower PIL pipeline")
             _failed = True
     return _lib
+
+
+def _log(msg: str) -> None:
+    # NOT master_print: that queries jax.process_index(), which would trigger
+    # (and on a dead transport, hang in) backend init from the data path.
+    # The env var is authoritative when set; otherwise every process logs the
+    # one-time build line, which is acceptable.
+    if os.environ.get("JAX_PROCESS_ID", "0") == "0":
+        print(msg, flush=True)
 
 
 def available() -> bool:

@@ -13,6 +13,8 @@
 // filter support scaled by the downscale factor, uint8 intermediate between the
 // horizontal and vertical passes) with float64 coefficient math where Pillow
 // uses int16 fixed point — outputs agree within 1 LSB (tests/test_native.py).
+// Algorithm from Pillow (python-pillow/Pillow, src/libImaging/Resample.c),
+// HPND license; re-derived here, not copied.
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 decode.cc -o libvitax_data.so -ljpeg -pthread
 // (done automatically by vitax/_native/__init__.py).

@@ -3,4 +3,5 @@ from vitax.checkpoint.orbax_io import (  # noqa: F401
     latest_epoch,
     restore_state,
     save_state,
+    wait_until_finished,
 )

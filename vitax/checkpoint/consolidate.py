@@ -34,6 +34,8 @@ def _flatten(tree, prefix=""):
 def consolidate(ckpt_dir: str, epoch: int, out: str, params_only: bool = True) -> dict:
     import orbax.checkpoint as ocp
 
+    from vitax.checkpoint.orbax_io import wait_until_finished
+    wait_until_finished()  # same-process async save of this epoch must commit
     path = epoch_ckpt_path(ckpt_dir, epoch)
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(path)  # host restore: full numpy arrays

@@ -14,12 +14,23 @@ utils.py:24-43; SURVEY.md section 3.3), improved the TPU-native way:
 - The LR schedule needs no state: it is a pure function of the restored `step`
   (reference saves lr_scheduler.state_dict, utils.py:31).
 
+Saves are ASYNC by default (VERDICT round-1 item 4): `save_state` snapshots
+device shards to host memory synchronously (so the caller may immediately
+donate/overwrite the state buffers in the next train step) and commits the
+write in a background thread — at 10B, the serialize+write no longer stalls
+every rank (improving on the reference's synchronous xm.save,
+utils.py:24-34). Atomicity is Orbax's tmp-dir+rename commit; `latest_epoch`
+only matches finalized `epoch_<N>` directory names, so a crash mid-write can
+never be resumed from. Call `wait_until_finished()` (epoch end, exit) or pass
+`wait=True` (final epoch) to drain.
+
 Single-file consolidation (consolidate_sharded_ckpts parity) lives in
 vitax/checkpoint/consolidate.py.
 """
 
 from __future__ import annotations
 
+import atexit
 import os
 import re
 from typing import Any, Optional
@@ -32,6 +43,32 @@ from vitax.utils.logging import master_print
 PyTree = Any
 
 _EPOCH_RE = re.compile(r"^epoch_(\d+)$")
+
+_CKPTR: Optional[ocp.StandardCheckpointer] = None
+
+
+def _checkpointer() -> ocp.StandardCheckpointer:
+    """One persistent async checkpointer per process (construction is not
+    free, and pending background writes must outlive a single save call)."""
+    global _CKPTR
+    if _CKPTR is None:
+        _CKPTR = ocp.StandardCheckpointer()
+        atexit.register(close)
+    return _CKPTR
+
+
+def wait_until_finished() -> None:
+    """Block until every in-flight async save has committed."""
+    if _CKPTR is not None:
+        _CKPTR.wait_until_finished()
+
+
+def close() -> None:
+    """Drain pending saves and release the checkpointer."""
+    global _CKPTR
+    if _CKPTR is not None:
+        _CKPTR.close()
+        _CKPTR = None
 
 
 def epoch_ckpt_path(ckpt_dir: str, epoch: int) -> str:
@@ -50,13 +87,20 @@ def latest_epoch(ckpt_dir: str) -> Optional[int]:
     return max(epochs) if epochs else None
 
 
-def save_state(ckpt_dir: str, epoch: int, state: PyTree) -> str:
+def save_state(ckpt_dir: str, epoch: int, state: PyTree,
+               wait: bool = False) -> str:
     """Save the train state for `epoch`; all hosts write their shards in
-    parallel (reference save_ckpt with master_only=False, utils.py:24-33)."""
+    parallel (reference save_ckpt with master_only=False, utils.py:24-33).
+
+    Returns as soon as the device->host snapshot is taken (the state may then
+    be donated to the next step); the write commits in background. wait=True
+    blocks until committed (final save / preemption-imminent path)."""
     path = epoch_ckpt_path(ckpt_dir, epoch)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=True)
-    master_print(f"checkpoint saved to {path}")
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=True)
+    if wait:
+        ckptr.wait_until_finished()
+    master_print(f"checkpoint save {'committed' if wait else 'started'}: {path}")
     return path
 
 
@@ -64,9 +108,9 @@ def restore_state(ckpt_dir: str, epoch: int, abstract_state: PyTree) -> PyTree:
     """Restore into the given abstract state (ShapeDtypeStructs carrying target
     shardings) — resharding across topologies as needed (reference load_ckpt,
     utils.py:37-43, without the same-topology restriction)."""
+    wait_until_finished()  # an in-flight save of this epoch must commit first
     path = epoch_ckpt_path(ckpt_dir, epoch)
     assert os.path.exists(path), f"checkpoint not found: {path}"
-    with ocp.StandardCheckpointer() as ckptr:
-        state = ckptr.restore(path, abstract_state)
+    state = _checkpointer().restore(path, abstract_state)
     master_print(f"resumed from checkpoint {path}")
     return state

@@ -37,19 +37,29 @@ def maybe_initialize() -> None:
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES")
     pid = os.environ.get("JAX_PROCESS_ID")
-    if coord:
-        if not (nproc or "").isdigit() or not (pid or "").isdigit():
+    tpu_pod = (int(os.environ.get("TPU_WORKER_COUNT", "1")) > 1
+               or bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")))
+    if coord and not ((nproc or "").isdigit() and (pid or "").isdigit()):
+        if tpu_pod:
+            # a pod that exports the coordinator address but leaves process
+            # count/id to TPU metadata: let auto-detection fill them in
+            import warnings
+            warnings.warn(
+                "JAX_COORDINATOR_ADDRESS is set without JAX_NUM_PROCESSES/"
+                "JAX_PROCESS_ID; using TPU metadata auto-detection instead")
+            coord = None
+        else:
             raise ValueError(
                 "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES="
                 f"{nproc!r} / JAX_PROCESS_ID={pid!r} are missing or not "
                 "integers — all three are required for explicit multi-process "
                 "bring-up (otherwise every process would silently train "
                 "standalone on the full dataset)")
+    if coord:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=int(nproc),
                                    process_id=int(pid))
-    elif (int(os.environ.get("TPU_WORKER_COUNT", "1")) > 1
-          or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")):
+    elif tpu_pod:
         # TPU pod: worker topology comes from env/metadata.
         jax.distributed.initialize()
     _initialized = True
@@ -63,13 +73,14 @@ def barrier(tag: str) -> None:
         multihost_utils.sync_global_devices(tag)
 
 
-def host_all_sum(value: Any):
-    """CPU-side cross-host sum of a Python scalar (xm.mesh_reduce parity,
-    reference run_vit_training.py:205,315-316). Prefer in-graph reductions —
-    this exists for host-only quantities."""
+def broadcast_from_process0(value: int) -> int:
+    """Host-level scalar broadcast: every process adopts process 0's value.
+    Used to agree on the auto-resume epoch when a non-atomic shared store
+    (e.g. GCS fuse) could give hosts different directory listings. No-op
+    single-host. (The reference's xm.mesh_reduce host plane, SURVEY.md
+    section 2.4, is otherwise compiled into the step as in-graph reductions.)"""
     if jax.process_count() == 1:
         return value
     from jax.experimental import multihost_utils
     import numpy as np
-    gathered = multihost_utils.process_allgather(np.asarray(value))
-    return gathered.sum()
+    return int(multihost_utils.broadcast_one_to_all(np.int64(value)))

@@ -16,6 +16,9 @@ Features mirrored from xla_dist:
     --restart           kill stale python processes on workers first
                         (--restart-tpuvm-pod-server parity)
     --logfile PATH      tee combined output to a local file (README.md:118 parity)
+    --max_restarts N    monitor the launch; on a nonzero worker exit, re-run
+                        the kill-stale + launch rounds up to N times
+                        (xla_dist's worker restart-on-failure, README.md:99-101)
 """
 
 from __future__ import annotations
@@ -46,6 +49,21 @@ def build_remote_command(cmd: list, env: list, workdir: str) -> str:
     return f"cd {_quote_workdir(workdir)} && {exports} {remote}"
 
 
+def _run_launch(gcloud: list, logfile) -> int:
+    """Run one launch round to completion, optionally teeing output."""
+    if logfile:
+        with open(logfile, "ab") as log:
+            proc = subprocess.Popen(gcloud, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT)
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                sys.stdout.buffer.write(line)
+                sys.stdout.buffer.flush()
+                log.write(line)
+            return proc.wait()
+    return subprocess.call(gcloud)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -55,6 +73,9 @@ def main(argv=None):
     p.add_argument("--env", action="append", default=[], metavar="KEY=VAL")
     p.add_argument("--restart", action="store_true",
                    help="kill stale training processes on all workers first")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="relaunch rounds after a nonzero worker exit "
+                        "(0 disables monitoring-based restart)")
     p.add_argument("--workdir", default="~/vitax")
     p.add_argument("--logfile", default=None)
     p.add_argument("--dry_run", action="store_true",
@@ -76,30 +97,31 @@ def main(argv=None):
             g.append(f"--project={args.project}")
         return g
 
-    if args.restart:
-        # separate SSH round so the kill pattern cannot match (and terminate)
-        # the shell carrying the training command itself
-        restart = gcloud_ssh(RESTART_CMD)
-        print("restarting: " + " ".join(shlex.quote(g) for g in restart), flush=True)
-        if not args.dry_run:
-            subprocess.call(restart)
-
     gcloud = gcloud_ssh(build_remote_command(cmd, args.env, args.workdir))
 
-    print("launching:", " ".join(shlex.quote(g) for g in gcloud), flush=True)
-    if args.dry_run:
-        return 0
-    if args.logfile:
-        with open(args.logfile, "ab") as log:
-            proc = subprocess.Popen(gcloud, stdout=subprocess.PIPE,
-                                    stderr=subprocess.STDOUT)
-            assert proc.stdout is not None
-            for line in proc.stdout:
-                sys.stdout.buffer.write(line)
-                sys.stdout.buffer.flush()
-                log.write(line)
-            return proc.wait()
-    return subprocess.call(gcloud)
+    rc = 1
+    for attempt in range(args.max_restarts + 1):
+        if args.restart or attempt > 0:
+            # separate SSH round so the kill pattern cannot match (and
+            # terminate) the shell carrying the training command itself;
+            # re-run before every relaunch so stale half-dead workers from the
+            # failed round can't hold the TPU
+            restart = gcloud_ssh(RESTART_CMD)
+            print("restarting: " + " ".join(shlex.quote(g) for g in restart),
+                  flush=True)
+            if not args.dry_run:
+                subprocess.call(restart)
+
+        print("launching:", " ".join(shlex.quote(g) for g in gcloud), flush=True)
+        if args.dry_run:
+            return 0
+        rc = _run_launch(gcloud, args.logfile)
+        if rc == 0:
+            return 0
+        print(f"worker exited with rc={rc} "
+              f"(attempt {attempt + 1}/{args.max_restarts + 1})", flush=True)
+    print(f"giving up after {args.max_restarts + 1} attempts", flush=True)
+    return rc
 
 
 if __name__ == "__main__":

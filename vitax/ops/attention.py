@@ -90,14 +90,16 @@ def _fwd(q, k, v, scale):
 # backward kernel
 # ---------------------------------------------------------------------------
 
-def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dlse_ref,
                 dq_ref, dk_ref, dv_ref, *, scale: float):
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
     o = o_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][0][:, None]  # (N, 1)
+    lse = lse_ref[0][0][:, None]    # (N, 1)
+    dlse = dlse_ref[0][0][:, None]  # (N, 1) — lse cotangent (zeros when the
+    # lse output is unused; nonzero under ring attention's logsumexp merge)
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
@@ -109,7 +111,8 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (N, 1)
-    ds = p * (dp - delta) * scale
+    # d lse_i / d s_ij = p_ij, so the lse cotangent adds dlse_i inside the parens
+    ds = p * (dp - delta + dlse) * scale
 
     dq = jax.lax.dot_general(
         ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -123,34 +126,42 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, res, do):
+def _bwd(scale, res, cts):
     q, k, v, o, lse = res
+    do, dlse = cts
     bh, n, dh = q.shape
     spec = pl.BlockSpec((1, n, dh), lambda i: (i, 0, 0))
     lse_spec = pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale),
         grid=(bh,),
-        in_specs=[spec, spec, spec, spec, lse_spec, spec],
+        in_specs=[spec, spec, spec, spec, lse_spec, spec, lse_spec],
         out_specs=[spec, spec, spec],
         out_shape=[jax.ShapeDtypeStruct((bh, n, dh), q.dtype)] * 3,
         interpret=_interpret(),
-    )(q, k, v, o, lse[:, None, :], do)
+    )(q, k, v, o, lse[:, None, :], do, dlse[:, None, :])
     return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_bh(q, k, v, scale):
-    o, _ = _fwd(q, k, v, scale)
-    return o
+def flash_bh_with_lse(q, k, v, scale):
+    """(BH, N, Dh) fused attention returning (o, lse); differentiable in BOTH
+    outputs — the lse cotangent feeds the backward kernel, which is what lets
+    ring attention merge per-block kernel results with plain autodiff
+    (vitax/parallel/ring_attention.py)."""
+    return _fwd(q, k, v, scale)
 
 
-def _flash_bh_fwd(q, k, v, scale):
+def _flash_bh_lse_fwd(q, k, v, scale):
     o, lse = _fwd(q, k, v, scale)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-_flash_bh.defvjp(_flash_bh_fwd, _bwd)
+flash_bh_with_lse.defvjp(_flash_bh_lse_fwd, _bwd)
+
+
+def _flash_bh(q, k, v, scale):
+    return flash_bh_with_lse(q, k, v, scale)[0]
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
@@ -220,7 +231,11 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
                 f"sp*tp ({cfg.num_heads} % {sp * tp} != 0); falling back to "
                 f"ring attention")
         from vitax.parallel.ring_attention import make_ring_attention
-        return _named(make_ring_attention(mesh), "ring attention (sp)")
+        # local block product through the Pallas kernels on TPU (whole-N or
+        # streaming by local length), dense jnp when kernels are disabled
+        use_kernel = None if cfg.use_flash_attention else False
+        return _named(make_ring_attention(mesh, use_kernel=use_kernel),
+                      "ring attention (sp)")
 
     kernel, name = _tpu_kernel(cfg, n)
     if kernel is None:

@@ -124,8 +124,8 @@ def blocked_fwd_padded(q, k, v, n_valid, scale, bq, bk):
 # ---------------------------------------------------------------------------
 
 def _dkv_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float, bk: int,
-                nq: int):
+                dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                bk: int, nq: int):
     jq = pl.program_id(2)
 
     @pl.when(jq == 0)
@@ -139,6 +139,7 @@ def _dkv_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][0][:, None]      # (BQ, 1)
     delta = delta_ref[0][0][:, None]  # (BQ, 1)
+    dlse = dlse_ref[0][0][:, None]    # (BQ, 1) — lse cotangent (ring merge)
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
@@ -151,7 +152,7 @@ def _dkv_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dp = jax.lax.dot_general(            # dO V^T
         do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - delta + dlse) * scale  # d lse_i/d s_ij = p_ij
     dk_acc[...] += jax.lax.dot_general(  # dS^T Q
         ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -163,7 +164,7 @@ def _dkv_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dq_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_acc, *, scale: float, bk: int, nk: int):
+               dlse_ref, dq_ref, dq_acc, *, scale: float, bk: int, nk: int):
     jk = pl.program_id(2)
 
     @pl.when(jk == 0)
@@ -176,6 +177,7 @@ def _dq_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][0][:, None]
     delta = delta_ref[0][0][:, None]
+    dlse = dlse_ref[0][0][:, None]
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
@@ -184,7 +186,7 @@ def _dq_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dp = jax.lax.dot_general(
         do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - delta + dlse) * scale
     dq_acc[...] += jax.lax.dot_general(
         ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -194,12 +196,13 @@ def _dq_kernel(n_valid_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def blocked_bwd_padded(q, k, v, o, lse, do, n_valid, scale, bq, bk):
+def blocked_bwd_padded(q, k, v, o, lse, do, dlse, n_valid, scale, bq, bk):
     bh, n_pad, dh = q.shape
     nq, nk = n_pad // bq, n_pad // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]  # (BH, 1, Np)
     lse3 = lse[:, None, :]
+    dlse3 = dlse[:, None, :]
 
     qspec_q = pl.BlockSpec((1, bq, dh), lambda b, jk, jq: (b, jq, 0))
     kspec_k = pl.BlockSpec((1, bk, dh), lambda b, jk, jq: (b, jk, 0))
@@ -208,7 +211,7 @@ def blocked_bwd_padded(q, k, v, o, lse, do, n_valid, scale, bq, bk):
         functools.partial(_dkv_kernel, scale=scale, bk=bk, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  qspec_q, kspec_k, kspec_k, qspec_q, row_q, row_q],
+                  qspec_q, kspec_k, kspec_k, qspec_q, row_q, row_q, row_q],
         out_specs=[kspec_k, kspec_k],
         out_shape=[jax.ShapeDtypeStruct((bh, n_pad, dh), q.dtype)] * 2,
         scratch_shapes=[pltpu.VMEM((bk, dh), jnp.float32),
@@ -216,7 +219,7 @@ def blocked_bwd_padded(q, k, v, o, lse, do, n_valid, scale, bq, bk):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(n_valid, q, k, v, do, lse3, delta)
+    )(n_valid, q, k, v, do, lse3, delta, dlse3)
 
     qspec = pl.BlockSpec((1, bq, dh), lambda b, jq, jk: (b, jq, 0))
     kspec = pl.BlockSpec((1, bk, dh), lambda b, jq, jk: (b, jk, 0))
@@ -225,14 +228,14 @@ def blocked_bwd_padded(q, k, v, o, lse, do, n_valid, scale, bq, bk):
         functools.partial(_dq_kernel, scale=scale, bk=bk, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  qspec, kspec, kspec, qspec, row, row],
+                  qspec, kspec, kspec, qspec, row, row, row],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, n_pad, dh), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(n_valid, q, k, v, do, lse3, delta)
+    )(n_valid, q, k, v, do, lse3, delta, dlse3)
     return dq, dk, dv
 
 
@@ -252,9 +255,12 @@ def _pad_seq(x, n_pad):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _blocked_bh(q, k, v, scale, bq, bk):
-    o, _ = _blocked_fwd_impl(q, k, v, scale, bq, bk)
-    return o
+def blocked_bh_with_lse(q, k, v, scale, bq, bk):
+    """(BH, N, Dh) streaming attention returning (o, lse); differentiable in
+    both outputs (the lse cotangent feeds the backward kernels) — composes with
+    ring attention's logsumexp merge for local blocks beyond the whole-N
+    kernel's VMEM ceiling."""
+    return _blocked_fwd_impl(q, k, v, scale, bq, bk)
 
 
 def _blocked_fwd_impl(q, k, v, scale, bq, bk):
@@ -269,25 +275,31 @@ def _blocked_fwd_impl(q, k, v, scale, bq, bk):
 
 def _blocked_bh_fwd(q, k, v, scale, bq, bk):
     o, lse = _blocked_fwd_impl(q, k, v, scale, bq, bk)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _blocked_bh_bwd(scale, bq, bk, res, do):
+def _blocked_bh_bwd(scale, bq, bk, res, cts):
     q, k, v, o, lse = res
+    do, dlse = cts
     n = q.shape[1]
     n_pad = _pad_len(n, math.lcm(bq, bk))
     n_valid = jnp.asarray([n], jnp.int32)
     pad = n_pad - n
     # padded q rows: lse=+inf makes p=exp(s-lse)=0, do=0 kills dv terms
     lse_p = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=jnp.inf)
+    dlse_p = jnp.pad(dlse, ((0, 0), (0, pad)))
     dq, dk, dv = blocked_bwd_padded(
         _pad_seq(q, n_pad), _pad_seq(k, n_pad), _pad_seq(v, n_pad),
-        _pad_seq(o, n_pad), lse_p, _pad_seq(do, n_pad),
+        _pad_seq(o, n_pad), lse_p, _pad_seq(do, n_pad), dlse_p,
         n_valid, scale, bq, bk)
     return dq[:, :n], dk[:, :n], dv[:, :n]
 
 
-_blocked_bh.defvjp(_blocked_bh_fwd, _blocked_bh_bwd)
+blocked_bh_with_lse.defvjp(_blocked_bh_fwd, _blocked_bh_bwd)
+
+
+def _blocked_bh(q, k, v, scale, bq, bk):
+    return blocked_bh_with_lse(q, k, v, scale, bq, bk)[0]
 
 
 def blocked_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,

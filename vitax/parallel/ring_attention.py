@@ -4,66 +4,120 @@ Long-context capability beyond the reference (which fixes sequence length at
 (image/patch)^2 = 256 tokens and scales only parameters — SURVEY.md section 5
 'long-context: absent'): activations are sharded over the token axis, and
 attention streams K/V blocks around the ring of "sp" neighbors via
-`jax.lax.ppermute` (one ICI hop per step), merging partial results with the
-online-softmax recurrence (blockwise attention a la Ring Attention,
-arXiv:2310.01889). Peak memory per chip is O(N/sp) activations and one K/V
-block; the (N, N) score matrix never exists.
+`jax.lax.ppermute` (one ICI hop per step), merging per-block results with a
+logsumexp merge (blockwise attention a la Ring Attention, arXiv:2310.01889).
+Peak memory per chip is O(N/sp) activations and one K/V block; the (N, N)
+score matrix never exists.
 
-Collectives ride the ICI ring — ppermute is the bandwidth-optimal primitive
-for neighbor exchange (see the scaling-book recipe: shard, permute, overlap).
+Design (TPU-first):
+- The sp-step block loop is UNROLLED (sp is a mesh-axis size — small and
+  static), and each step issues the K/V rotation for the NEXT block *before*
+  computing the current one. The rotation has no data dependence on the block
+  product, so XLA's latency-hiding scheduler turns each collective-permute
+  into a start/done pair overlapped with the MXU work — double buffering,
+  scheduled by the compiler.
+- Exactly sp-1 rotations per tensor: the last block computes without a
+  permute (there is no next block to fetch).
+- The local block product runs on the Pallas kernels on TPU: the whole-N
+  fused kernel up to MAX_SEQ_IN_VMEM local tokens, the streaming (blocked)
+  kernel beyond it — both return (o, lse) and are differentiable in both, so
+  the merge is plain autodiff (vitax/ops/attention.py, flash_blocked.py).
+  Off-TPU (CPU tests) the dense jnp block product is used.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, scale: float):
+def _dense_block(q, k, v, scale: float):
+    """Dense jnp block product: q (B, nq, H, Dh) x k/v (B, nk, H, Dh) ->
+    (o (B, nq, H, Dh) f32 softmax-normalized within the block, lse (B, H, nq))."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / l, v.astype(jnp.float32))
+    lse = (m + jnp.log(l))[..., 0]  # (B, H, nq)
+    return o, lse
+
+
+def _kernel_block(q, k, v, scale: float):
+    """Pallas block product: whole-N fused kernel when the local block fits
+    VMEM, streaming (blocked) kernel beyond — same (o, lse) contract."""
+    from vitax.ops.attention import MAX_SEQ_IN_VMEM, flash_bh_with_lse
+
+    b, nq, h, dh = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, -1, dh)
+
+    if nq <= MAX_SEQ_IN_VMEM:
+        o, lse = flash_bh_with_lse(to_bh(q), to_bh(k), to_bh(v), scale)
+    else:
+        from vitax.ops.flash_blocked import (
+            DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, blocked_bh_with_lse)
+        o, lse = blocked_bh_with_lse(to_bh(q), to_bh(k), to_bh(v), scale,
+                                     DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    o = o.reshape(b, h, nq, dh).transpose(0, 2, 1, 3).astype(jnp.float32)
+    return o, lse.reshape(b, h, nq)
+
+
+def _merge(o, lse, o_blk, lse_blk):
+    """Combine two softmax-normalized partial results via their logsumexps."""
+    lse_new = jnp.logaddexp(lse, lse_blk)                    # (B, H, N)
+    w = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]         # (B,N,H,1)
+    w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
+    return o * w + o_blk * w_blk, lse_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
+                          block_fn: Callable):
     """shard_map body. q, k, v: (B, N_loc, H, Dh) — the local token shard.
-    Streams K/V blocks around the ring, merging with online softmax."""
+    Streams K/V blocks around the ring; each device visits all sp blocks."""
     sp = jax.lax.axis_size(axis_name)
-    b, n_loc, h, dh = q.shape
-
-    qf = q.astype(jnp.float32)
-    m = jnp.full((b, h, n_loc, 1), -jnp.inf, jnp.float32)   # running row max
-    l = jnp.zeros((b, h, n_loc, 1), jnp.float32)            # running denominator
-    o = jnp.zeros((b, h, n_loc, dh), jnp.float32)           # unnormalized out
-
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def body(i, carry):
-        k_blk, v_blk, m, l, o = carry
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * corr + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        # rotate K/V to the next ring neighbor (skipped after the last block)
-        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-        return k_nxt, v_nxt, m_new, l, o
-
-    _, _, _, l, o = jax.lax.fori_loop(0, sp, body, (k, v, m, l, o))
-    out = (o / l).transpose(0, 2, 1, 3)  # (B, N_loc, H, Dh)
-    return out.astype(q.dtype)
+    k_blk, v_blk = k, v
+    o = lse = None
+    for step in range(sp):
+        last = step == sp - 1
+        if not last:
+            # issue the rotation BEFORE the block product — no data dependence,
+            # so the collective-permute overlaps the MXU work (double buffer)
+            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        o_blk, lse_blk = block_fn(q, k_blk, v_blk, scale)
+        o, lse = (o_blk, lse_blk) if o is None else _merge(o, lse, o_blk, lse_blk)
+        if not last:
+            k_blk, v_blk = k_nxt, v_nxt
+    return o.astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
+                        use_kernel: Optional[bool] = None):
     """Build a (B, N, H, Dh) -> (B, N, H, Dh) attention core with the token
-    axis sharded over `axis_name`; batch over (dp, fsdp), heads over tp."""
+    axis sharded over `axis_name`; batch over (dp, fsdp), heads over tp.
+
+    use_kernel: True -> Pallas block product (interpret mode off-TPU),
+    False -> dense jnp, None -> Pallas exactly on TPU.
+    """
+    if use_kernel is None:
+        use_kernel = jax.devices()[0].platform == "tpu"
+    block_fn = _kernel_block if use_kernel else _dense_block
     spec = P(("dp", "fsdp"), axis_name, "tp", None)
 
     def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         scale = q.shape[-1] ** -0.5
         fn = jax.shard_map(
-            functools.partial(_ring_attention_local, axis_name=axis_name, scale=scale),
+            functools.partial(_ring_attention_local, axis_name=axis_name,
+                              scale=scale, block_fn=block_fn),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )

@@ -59,7 +59,9 @@ def train(cfg: Config) -> TrainState:
     if cfg.resume_epoch < 0:  # auto-resume: latest complete checkpoint, if any
         from vitax.checkpoint.orbax_io import latest_epoch
         import dataclasses
-        found = latest_epoch(cfg.ckpt_dir) or 0
+        # process 0 picks, everyone adopts: a non-atomic shared-store view
+        # (e.g. GCS fuse) must not let hosts disagree on the resume epoch
+        found = distributed.broadcast_from_process0(latest_epoch(cfg.ckpt_dir) or 0)
         cfg = dataclasses.replace(cfg, resume_epoch=found)
         master_print(f"auto-resume: {'epoch ' + str(found) if found else 'no checkpoint found, fresh start'}")
     model = build_model(cfg, attention_impl=attention_impl,
@@ -78,6 +80,13 @@ def train(cfg: Config) -> TrainState:
     master_print(f"\n=== model ===\n{model}\n")
     master_print(f"global parameter num: {count_params(state.params)}")
     master_print(f"per-device (sharded) parameter num: {_sharded_param_count(state)}")
+    from vitax.train.state import ADAMW_HPARAMS
+    master_print(  # optimizer dump at startup (reference run_vit_training.py:242)
+        f"\n=== optimizer ===\nAdamW(lr=warmup_cosine(base={cfg.lr}, "
+        f"warmup={cfg.warmup_steps}, max_iteration={max_iteration}), "
+        f"betas=({ADAMW_HPARAMS['b1']}, {ADAMW_HPARAMS['b2']}), "
+        f"eps={ADAMW_HPARAMS['eps']}, weight_decay={cfg.weight_decay}, "
+        f"clip_grad_norm={cfg.clip_grad_norm})\n")
     distributed.barrier("loaded optimizer")
 
     train_step = make_train_step(cfg, model, tx, mesh, state_specs)
@@ -99,6 +108,8 @@ def train(cfg: Config) -> TrainState:
             master_print(f"profile trace written to {cfg.profile_dir}")
         train_loader.close()
         val_loader.close()
+        from vitax.checkpoint.orbax_io import wait_until_finished
+        wait_until_finished()  # drain any in-flight async save before exit
 
     master_print("training completed")
     return state
@@ -141,7 +152,10 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
         master_print(f"epoch {epoch} done ({time.time() - time_epoch_b:.2f} sec)")
 
         if epoch % cfg.ckpt_epoch_interval == 0 or epoch == cfg.num_epochs:
-            save_state(cfg.ckpt_dir, epoch, state)
+            # async: the device->host snapshot happens before return, the write
+            # commits in background while the next epoch trains; the final save
+            # waits so training never exits with an uncommitted checkpoint
+            save_state(cfg.ckpt_dir, epoch, state, wait=epoch == cfg.num_epochs)
         if epoch % cfg.test_epoch_interval == 0 or epoch == cfg.num_epochs:
             accuracy, _, _ = eval_on_val(cfg, val_loader, eval_step, state)
             master_print(f"accuracy on val: {accuracy:.4f}")

@@ -50,8 +50,13 @@ def build_optimizer(cfg: Config, max_iteration: int) -> Tuple[optax.GradientTran
     if cfg.clip_grad_norm > 0:
         parts.append(optax.clip_by_global_norm(cfg.clip_grad_norm))
     parts.append(
-        optax.adamw(schedule, b1=0.9, b2=0.999, eps=1e-8, weight_decay=cfg.weight_decay))
+        optax.adamw(schedule, weight_decay=cfg.weight_decay, **ADAMW_HPARAMS))
     return optax.chain(*parts), schedule
+
+
+# torch.optim.AdamW defaults (reference run_vit_training.py:237); the startup
+# optimizer dump (train/loop.py) prints from this same dict
+ADAMW_HPARAMS = dict(b1=0.9, b2=0.999, eps=1e-8)
 
 
 def make_train_state(

@@ -90,7 +90,10 @@ def make_train_step(
         metrics = {
             "loss": loss,
             "grad_norm": optax.global_norm(grads),
-            "lr_step": state.step,  # host resolves lr via the schedule fn
+            # post-step schedule position: the reference logs lr AFTER
+            # lr_scheduler.step() (run_vit_training.py:288); the host resolves
+            # the value via the pure schedule fn
+            "lr_step": new_state.step,
         }
         return new_state, metrics
 
