@@ -152,3 +152,44 @@ def test_full_loop_fake_data(devices8, tmp_path):
     assert int(jax.device_get(state.step)) == 3
     import os
     assert os.path.isdir(os.path.join(str(tmp_path / "ckpt"), "epoch_1"))
+
+
+def test_sigterm_preemption_save(devices8, tmp_path):
+    """SIGTERM mid-training -> committed checkpoint + clean exit + auto-resume
+    (the preemption story the async checkpointer enables; vitax/train/preempt.py)."""
+    import os
+    import signal
+
+    from vitax.train import preempt
+    from vitax.train.loop import train
+
+    preempt.reset()
+    assert preempt.install()  # main thread in pytest
+    # deliver a real SIGTERM; Python runs the handler at the next bytecode
+    # boundary, so the flag is set before train() begins stepping
+    os.kill(os.getpid(), signal.SIGTERM)
+    try:
+        cfg = tiny_cfg(
+            fake_data=True, num_epochs=3, steps_per_epoch=50, log_step_interval=99,
+            ckpt_dir=str(tmp_path / "ckpt"), ckpt_epoch_interval=99,
+            test_epoch_interval=99, num_workers=2, eval_max_batches=1,
+        )
+        state = train(cfg)
+        # exited after ONE step of epoch 1 (not 3 epochs x 50 steps)
+        assert int(jax.device_get(state.step)) == 1
+        assert os.path.isdir(os.path.join(str(tmp_path / "ckpt"), "epoch_1"))
+        # train() restored the pre-install SIGTERM disposition on exit, so
+        # post-training work (and this pytest process) keeps normal semantics
+        assert signal.getsignal(signal.SIGTERM) is not preempt._handler
+    finally:
+        preempt.uninstall()
+        preempt.reset()
+
+    # auto-resume picks the preemption checkpoint up and continues at epoch 2
+    cfg2 = tiny_cfg(
+        fake_data=True, num_epochs=2, steps_per_epoch=2, log_step_interval=99,
+        resume_epoch=-1, ckpt_dir=str(tmp_path / "ckpt"), ckpt_epoch_interval=99,
+        test_epoch_interval=99, num_workers=2, eval_max_batches=1,
+    )
+    state2 = train(cfg2)
+    assert int(jax.device_get(state2.step)) == 3  # 1 saved + epoch-2's 2 steps

@@ -84,3 +84,18 @@ def broadcast_from_process0(value: int) -> int:
     from jax.experimental import multihost_utils
     import numpy as np
     return int(multihost_utils.broadcast_one_to_all(np.int64(value)))
+
+
+def any_across_processes(value: bool) -> bool:
+    """True iff ANY process passes True. Collective: when process_count > 1
+    every process must call this at the same point (the train loop calls it
+    on a fixed step cadence). Used for preemption agreement — a SIGTERM
+    delivered to a subset of hosts must still stop ALL hosts at the same step,
+    or the preemption save's collectives would interleave with other hosts'
+    train steps and deadlock. Free single-host."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+    import numpy as np
+    return bool(np.max(multihost_utils.process_allgather(
+        np.int32(bool(value)))))

@@ -28,6 +28,12 @@ from vitax.train.step import make_eval_step, make_train_step
 from vitax.utils.logging import master_print, memory_summary
 from vitax.utils.metrics import SmoothedValue
 
+# Multi-host preemption-flag sync cadence (steps). Bounds the extra exposure
+# after SIGTERM to min(10 steps, rest of the epoch) of wall time — the epoch
+# boundary always syncs too. Hosts must use the SAME constant (the flag sync
+# is a collective).
+PREEMPT_SYNC_STEPS = 10
+
 
 def _sharded_param_count(state: TrainState) -> int:
     """Per-device (sharded) parameter count — the reference prints this as
@@ -94,6 +100,8 @@ def train(cfg: Config) -> TrainState:
 
     smoothed_loss = SmoothedValue(window_size=5)
     smoothed_time = SmoothedValue(window_size=5)
+    from vitax.train import preempt
+    preempt.install()  # SIGTERM -> committed save -> clean exit
     distributed.barrier("training begins")
     master_print("training begins (the first few iterations are very slow due to compilation)")
 
@@ -110,9 +118,28 @@ def train(cfg: Config) -> TrainState:
         val_loader.close()
         from vitax.checkpoint.orbax_io import wait_until_finished
         wait_until_finished()  # drain any in-flight async save before exit
+        preempt.uninstall()  # restore normal SIGTERM for post-training work
 
     master_print("training completed")
     return state
+
+
+def _preempt_agreed(step_in_epoch) -> bool:
+    """Did SIGTERM arrive, as agreed by ALL hosts? Single-host: the local flag
+    (free, checked every step). Multi-host: the flag sync is a collective, so
+    every host must call it at the same points — every PREEMPT_SYNC_STEPS
+    steps in the step loop, and unconditionally at each epoch boundary
+    (step_in_epoch=None) so epochs shorter than the cadence are still covered.
+    Without agreement, one host entering the save while others keep stepping
+    would interleave mismatched collectives and deadlock the pod."""
+    from vitax.train import preempt
+    if jax.process_count() == 1:
+        return preempt.requested()
+    on_cadence = (step_in_epoch is None
+                  or (step_in_epoch + 1) % PREEMPT_SYNC_STEPS == 0)
+    if not on_cadence:
+        return False
+    return distributed.any_across_processes(preempt.requested())
 
 
 def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
@@ -144,12 +171,31 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             is_first_iter = epoch == cfg.resume_epoch + 1 and step == 0
             if is_first_iter or (step + 1) % cfg.log_step_interval == 0:
                 _run_logging(cfg, epoch, step, metrics, schedule, smoothed_loss, smoothed_time)
+            if _preempt_agreed(step_in_epoch=step):
+                # commit a synchronous save of the live mid-epoch state under
+                # this epoch's name, drain, and leave. Auto-resume
+                # (--resume_epoch -1) restarts at epoch+1 with the saved
+                # optimizer/step state; the remainder of this epoch's data is
+                # skipped (the framework's epoch-granular resume contract).
+                master_print(f"SIGTERM received: saving preemption checkpoint "
+                             f"at epoch {epoch} (step {step + 1}) and exiting")
+                jax.device_get(metrics["loss"])  # fence: step must be done
+                save_state(cfg.ckpt_dir, epoch, state, wait=True)
+                return state
             if cfg.max_steps and total_steps >= cfg.max_steps:
                 break
 
         if metrics is not None:
             jax.device_get(metrics["loss"])  # fence: honest epoch wall time
         master_print(f"epoch {epoch} done ({time.time() - time_epoch_b:.2f} sec)")
+
+        if _preempt_agreed(step_in_epoch=None):  # epoch boundary: always sync
+            # epochs shorter than the in-loop cadence still get a preemption
+            # save here (every host reaches the boundary at the same point)
+            master_print(f"SIGTERM received: saving preemption checkpoint "
+                         f"after epoch {epoch} and exiting")
+            save_state(cfg.ckpt_dir, epoch, state, wait=True)
+            return state
 
         if epoch % cfg.ckpt_epoch_interval == 0 or epoch == cfg.num_epochs:
             # async: the device->host snapshot happens before return, the write

@@ -1,0 +1,73 @@
+"""Preemption-safe shutdown: SIGTERM -> committed checkpoint -> clean exit.
+
+TPU VMs (and most cluster schedulers) deliver SIGTERM with a grace window
+before a hard kill. The reference has no preemption story — recovery is a
+manual relaunch with --resume_epoch (reference run_vit_training.py:246-248,
+README.md restart notes). Here the async-checkpoint design (orbax_io.py)
+makes a graceful path cheap: the handler only sets a flag; the train loop
+checks it at the next step boundary, takes a synchronous (wait=True) save of
+the live state, drains the async checkpointer, and returns — so `--resume_epoch
+-1` auto-resume finds a complete, committed checkpoint.
+
+The flag-then-poll design keeps the handler async-signal-safe (no JAX, no IO
+inside the handler) and the save on the main thread where the device state
+lives.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+_REQUESTED = threading.Event()
+_INSTALLED = False
+_PREV_HANDLER = None
+
+
+def _handler(signum, frame):  # noqa: ARG001 — signal handler signature
+    _REQUESTED.set()
+
+
+def install() -> bool:
+    """Install the SIGTERM handler (idempotent). Returns False when not on the
+    main thread (signal.signal raises there — e.g. pytest-xdist workers);
+    preemption saving is then simply unavailable, never fatal."""
+    global _INSTALLED, _PREV_HANDLER
+    if _INSTALLED:
+        return True
+    try:
+        _PREV_HANDLER = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread
+        return False
+    # a SIGTERM that arrived after a PREVIOUS train() stopped polling (e.g.
+    # during its final eval/drain) must not preempt THIS run at step 1
+    _REQUESTED.clear()
+    _INSTALLED = True
+    return True
+
+
+def uninstall() -> None:
+    """Restore the pre-install SIGTERM disposition (idempotent). train() calls
+    this on exit so post-training work (consolidation, host scripts, pytest)
+    keeps normal SIGTERM semantics instead of a flag nobody polls."""
+    global _INSTALLED, _PREV_HANDLER
+    if not _INSTALLED:
+        return
+    try:
+        signal.signal(signal.SIGTERM,
+                      _PREV_HANDLER if _PREV_HANDLER is not None
+                      else signal.SIG_DFL)
+    except ValueError:
+        pass  # not the main thread: leave as-is
+    _INSTALLED = False
+    _PREV_HANDLER = None
+
+
+def requested() -> bool:
+    """True once SIGTERM has been delivered (sticky until reset())."""
+    return _REQUESTED.is_set()
+
+
+def reset() -> None:
+    """Clear the flag (tests; or a supervisor that decides to continue)."""
+    _REQUESTED.clear()
