@@ -128,28 +128,88 @@ def test_no_all_gather_is_stack_sized(l14):
         "(ZeRO-3 memory bet violated)")
 
 
-def test_block_all_gathers_are_inside_scan_loop(l14):
-    """XLA preserves source scope in op_name metadata: the block-weight
-    gathers must carry `while/body` scope in BOTH the forward scan and the
-    rematted backward scan, and every gather outside a while body must be a
-    non-block (patchify / pos-embed / head / batch) tensor."""
-    cfg, state, compiled = l14
-    txt = compiled.as_text()
-    ag_lines = [l for l in txt.splitlines() if re.search(r"= \S+ all-gather\(", l)]
-    scoped = []
-    for line in ag_lines:
+def _hlo_computations(txt: str) -> dict:
+    """Parse compiled HLO text into {computation_name: [instruction lines]}.
+    Computation definitions start at column 0 as `%name (params) -> type {`
+    (optionally prefixed with ENTRY)."""
+    comps = {}
+    name = None
+    for line in txt.splitlines():
+        m = re.match(r"(?:ENTRY\s+)?(%[\w.\-]+)\s*\(", line)
+        if m and line.rstrip().endswith("{"):
+            name = m.group(1)
+            comps[name] = []
+        elif name is not None:
+            if line.startswith("}"):
+                name = None
+            else:
+                comps[name].append(line)
+    return comps
+
+
+def _while_body_names(txt: str) -> set:
+    """Computation names referenced as `body=` by while ops — the structural
+    (metadata-independent) definition of 'inside the scan loop'."""
+    return set(re.findall(r"body=(%[\w.\-]+)", txt))
+
+
+def _check_block_gathers_inside_loop(txt: str) -> None:
+    """Assert the ZeRO-3 scheduling property from compiled HLO structure:
+    block-weight all-gathers live inside while-loop bodies (fwd AND rematted
+    bwd), and no gather outside a loop body touches the stacked block params.
+
+    Loop membership is STRUCTURAL (the gather's enclosing computation is some
+    while op's `body=`), not an op_name substring match. op_name metadata is
+    still used to classify fwd vs rematted-bwd and to name outside gathers —
+    so its presence is asserted first: if XLA ever stops emitting it, this
+    fails loudly instead of silently green-lighting a regression."""
+    comps = _hlo_computations(txt)
+    bodies = _while_body_names(txt)
+    assert bodies, "no while loops found in compiled HLO — scan disappeared"
+
+    in_loop, outside = [], []
+    for cname, lines in comps.items():
+        for line in lines:
+            if re.search(r"= \S+ all-gather", line):
+                (in_loop if cname in bodies else outside).append(line)
+    assert in_loop, "no all-gathers inside any while body — ZeRO-3 bet violated"
+
+    def op_name(line):
         m = re.search(r'op_name="([^"]*)"', line)
-        scoped.append(m.group(1) if m else "")
-    fwd_in_loop = [s for s in scoped
-                   if "while/body" in s and "transpose" not in s and "blocks" in s]
-    bwd_in_loop = [s for s in scoped
-                   if "while/body" in s and "transpose" in s and "blocks" in s]
-    outside = [s for s in scoped if "while/body" not in s]
-    assert fwd_in_loop, f"no forward in-loop block gathers; scopes: {scoped}"
-    assert bwd_in_loop, f"no backward in-loop block gathers; scopes: {scoped}"
-    for s in outside:
+        return m.group(1) if m else ""
+
+    in_scopes = [op_name(l) for l in in_loop]
+    out_scopes = [op_name(l) for l in outside]
+    # metadata guard: every gather must carry a real op_name before we trust
+    # any classification built on it
+    assert all(in_scopes) and all(out_scopes), (
+        f"all-gather missing op_name metadata — cannot verify scheduling; "
+        f"in-loop: {in_scopes}, outside: {out_scopes}")
+
+    fwd = [s for s in in_scopes if "blocks" in s and "transpose" not in s]
+    bwd = [s for s in in_scopes if "blocks" in s and "transpose" in s]
+    assert fwd, f"no forward in-loop block gathers; in-loop scopes: {in_scopes}"
+    assert bwd, f"no rematted-backward in-loop block gathers; in-loop scopes: {in_scopes}"
+    for s in out_scopes:
         assert "blocks" not in s, (
             f"block-parameter all-gather hoisted out of the scan loop: {s}")
+
+
+def test_block_all_gathers_are_inside_scan_loop(l14):
+    """The block-weight gathers run once per layer step inside the scan's
+    while loop — forward and rematted backward — never hoisted whole."""
+    cfg, state, compiled = l14
+    _check_block_gathers_inside_loop(compiled.as_text())
+
+
+def test_scope_check_fails_when_metadata_stripped(l14):
+    """Negative control: with op_name metadata stripped from the HLO the
+    checker must FAIL (not silently pass) — the round-2 weakness where the
+    `outside` check green-lit metadata-free text."""
+    cfg, state, compiled = l14
+    txt = re.sub(r',?\s*op_name="[^"]*"', "", compiled.as_text())
+    with pytest.raises(AssertionError, match="op_name"):
+        _check_block_gathers_inside_loop(txt)
 
 
 @pytest.mark.slow
@@ -166,3 +226,73 @@ def test_10b_shape_traces_and_lowers(devices8):
     assert n == expected_param_count(cfg) == 10_077_917_160
     txt = lowered.as_text()
     assert "stablehlo.while" in txt  # the 32-block scan survived lowering
+
+
+@pytest.mark.slow
+def test_60b_shape_readiness(devices8):
+    """BASELINE config 5 (60B-class, reference README.md:122 "e.g. 60B"):
+
+    1. eval_shape the full train state at 8192-dim/80-block (~64.5B params) —
+       nothing materializes;
+    2. every >=2D parameter's spec actually shards over a virtual 256-way fsdp
+       axis (v5p-256), and the per-device state bytes fit v5p HBM (95 GB) with
+       a large margin;
+    3. the shard_on_cpu (host-offload) init path's host-RAM requirement is
+       computed and sane to document;
+    4. the train step AOT-lowers end-to-end at this shape on the test mesh.
+    """
+    from vitax.models.vit import expected_param_count
+    from vitax.parallel.sharding import param_pspec, state_specs_like
+    from vitax.parallel.sharding import _path_names
+
+    cfg = Config(image_size=224, patch_size=14, embed_dim=8192, num_heads=64,
+                 num_blocks=80, num_classes=1000, batch_size=8,
+                 warmup_steps=0).validate()
+
+    state, lowered = _lower_train_step(cfg)
+    n = sum(x.size for x in jax.tree.leaves(state.params))
+    assert n == expected_param_count(cfg)
+    assert n > 60e9, f"{n/1e9:.1f}B params is not 60B-class"
+    assert "stablehlo.while" in lowered.as_text()  # 80-block scan intact
+
+    # --- virtual v5p-256: specs computed analytically, no 256 devices needed
+    VIRT = (1, 256, 1, 1)  # (dp, fsdp, tp, sp)
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    pspecs = {}
+    for path, leaf in flat:
+        spec = param_pspec(path, leaf.shape, cfg, VIRT, cfg.scan_blocks)
+        pspecs[_path_names(path)] = spec
+        if leaf.ndim >= 2:  # every matrix/stacked tensor must shard
+            assert "fsdp" in tuple(spec), (
+                f"{_path_names(path)} {leaf.shape} unsharded at fsdp=256")
+
+    def shard_bytes(leaf, spec):
+        denom = 1
+        for axis in tuple(spec):
+            if axis == "fsdp":
+                denom *= 256
+        return leaf.size * leaf.dtype.itemsize / denom
+
+    # state = f32 params + AdamW mu + nu (all param-shaped, same specs —
+    # state_specs_like) + scalar step
+    params_tree = state.params
+    spec_tree = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: pspecs[_path_names(path)], params_tree)
+    state_specs = state_specs_like(state, spec_tree)
+    per_device = sum(
+        shard_bytes(leaf, spec) for (path, leaf), (_, spec) in zip(
+            jax.tree_util.tree_flatten_with_path(state)[0],
+            jax.tree_util.tree_flatten_with_path(
+                state_specs, is_leaf=lambda x: isinstance(
+                    x, jax.sharding.PartitionSpec))[0]))
+    V5P_HBM = 95e9
+    assert per_device < 0.10 * V5P_HBM, (
+        f"per-device 60B state {per_device/1e9:.1f} GB leaves too little HBM "
+        "headroom for activations/temps on v5p")
+
+    # --- shard_on_cpu path: full f32 params materialize in host RAM first
+    # (reference run_vit_training.py:175-181 semantics; README.md:122 tcmalloc
+    # note). Documented in BASELINE.md row 5; born-sharded init needs none.
+    host_bytes = sum(x.size * x.dtype.itemsize
+                     for x in jax.tree.leaves(state.params))
+    assert 2.3e11 < host_bytes < 3.0e11  # ~258 GB — host-RAM sized, not HBM
