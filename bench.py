@@ -122,6 +122,33 @@ def detect_peak_tflops(device_kind: str) -> float:
     return 197.0  # conservative default
 
 
+def train_presets(n_dev: int) -> dict:
+    """Benchmark model shapes (shared with tools/profile_step.py so traces
+    explain exactly the configs the bench measures)."""
+    return {
+        "tiny": dict(image_size=224, patch_size=16, embed_dim=192, num_heads=3,
+                     num_blocks=12, batch_size=64 * n_dev),
+        # BASELINE.json config 2 shape (ViT-B/16, pure-DP benchmark)
+        "b16": dict(image_size=224, patch_size=16, embed_dim=768, num_heads=12,
+                    num_blocks=12, batch_size=64 * n_dev),
+        "l14": dict(image_size=224, patch_size=14, embed_dim=1024, num_heads=16,
+                    num_blocks=24, batch_size=32 * n_dev),
+        "10b": dict(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
+                    num_blocks=32, batch_size=8 * n_dev),
+        # largest 10B-family slice that fits one v5e chip: same 5120-dim blocks,
+        # depth cut to 4 so params+moments+activations stay under 16 GB HBM
+        "10b_slice": dict(image_size=224, patch_size=14, embed_dim=5120,
+                          num_heads=32, num_blocks=4, batch_size=8 * n_dev),
+    }
+
+
+def default_remat_policy(preset: str) -> str:
+    """Per-preset remat default (measured on v5e l14: dots_attn_saveable 192.9
+    > dots_saveable 190.2 > none_saveable img/s/chip; the 10B flagship keeps
+    none_saveable — minimal HBM residency is what makes it fit)."""
+    return "none_saveable" if preset.startswith("10b") else "dots_attn_saveable"
+
+
 def model_flops_per_image(cfg) -> float:
     """Useful matmul FLOPs per image, fwd+bwd (3x forward)."""
     d, L = cfg.embed_dim, cfg.num_blocks
@@ -182,15 +209,28 @@ def bench_data_pipeline(args) -> None:
         native_ips = run(True)
         pil_ips = run(False)
 
-    base = read_baseline().get("data", {})
+    baseline = read_baseline()
+    base = baseline.get("data", {})
     vs = native_ips / base["native_images_per_sec"] if base.get(
         "native_images_per_sec") else 1.0
     if args.write_baseline:
+        # the data->train link (VERDICT round-2 weakness 6): for every train
+        # preset already measured, record whether ONE host's native pipeline
+        # keeps ALL of that host's chips fed (ratio > 1 = never input-bound;
+        # the host must supply images_per_sec_chip x local chip count)
+        feeds = {}
+        for preset, entry in baseline.items():
+            ips_chip = entry.get("images_per_sec_chip") if isinstance(
+                entry, dict) else None
+            if ips_chip:
+                host_consumption = ips_chip * entry.get("n_devices", 1)
+                feeds[preset] = round(native_ips / host_consumption, 2)
         write_baseline("data", {
             "native_images_per_sec": round(native_ips, 1),
             "pil_images_per_sec": round(pil_ips, 1),
             "speedup": round(native_ips / pil_ips, 2) if pil_ips else 0.0,
             "threads": args.data_threads,
+            "feed_ratio_vs_train_preset": feeds,
         })
     emit({
         "metric": f"host data pipeline images/sec (native C++ decode+augment, "
@@ -225,27 +265,11 @@ def bench_train(args, metric_stub: str) -> None:
     from vitax.train.step import make_train_step
     from jax.sharding import NamedSharding
 
-    presets = {
-        "tiny": dict(image_size=224, patch_size=16, embed_dim=192, num_heads=3,
-                     num_blocks=12, batch_size=64 * n_dev),
-        # BASELINE.json config 2 shape (ViT-B/16, pure-DP benchmark)
-        "b16": dict(image_size=224, patch_size=16, embed_dim=768, num_heads=12,
-                    num_blocks=12, batch_size=64 * n_dev),
-        "l14": dict(image_size=224, patch_size=14, embed_dim=1024, num_heads=16,
-                    num_blocks=24, batch_size=32 * n_dev),
-        "10b": dict(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
-                    num_blocks=32, batch_size=8 * n_dev),
-        # largest 10B-family slice that fits one v5e chip: same 5120-dim blocks,
-        # depth cut to 4 so params+moments+activations stay under 16 GB HBM
-        "10b_slice": dict(image_size=224, patch_size=14, embed_dim=5120,
-                          num_heads=32, num_blocks=4, batch_size=8 * n_dev),
-    }
-    kw = presets[args.preset]
+    kw = train_presets(n_dev)[args.preset]
     if args.batch_size:
         kw["batch_size"] = args.batch_size
     if args.remat_policy is None:
-        args.remat_policy = ("none_saveable" if args.preset.startswith("10b")
-                             else "dots_saveable")
+        args.remat_policy = default_remat_policy(args.preset)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt,
                  use_flash_attention=args.use_flash_attention, **kw).validate()
@@ -320,7 +344,7 @@ def main():
     # on v5e where activations fit; the 10B flagship keeps none_saveable
     # (minimal HBM residency is what makes it fit)
     p.add_argument("--remat_policy", default=None,
-                   choices=["none_saveable", "dots_saveable"])
+                   choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
     p.add_argument("--no_grad_ckpt", action="store_false", dest="grad_ckpt")
     p.add_argument("--no_flash_attention", action="store_false",
                    dest="use_flash_attention")

@@ -39,6 +39,9 @@ class Config:
     num_blocks: int = 32
     mlp_ratio: float = 4.0
     pos_dropout: float = 0.0
+    # NOTE: att_dropout > 0 routes *training* attention through the dense
+    # O(N^2) path — the Pallas kernels have no dropout hook (a startup warning
+    # is printed; see vitax/ops/attention.py make_attention_impl).
     att_dropout: float = 0.0
     mlp_dropout: float = 0.0
     num_classes: int = 1000
@@ -72,9 +75,10 @@ class Config:
     device_normalize: bool = True       # ship uint8 batches; normalize on-device (4x less host->device traffic)
     # none_saveable = the reference's checkpoint_module semantics (recompute
     # everything) and the least HBM — the right default for the 10B+ flagship.
-    # dots_saveable (keep MXU outputs, recompute elementwise) measured faster
-    # where it fits (v5e l14: 164.2 vs 155.8 img/s/chip) — bench selects it.
-    remat_policy: str = "none_saveable" # none_saveable | dots_saveable (only used if grad_ckpt)
+    # Measured on v5e l14 (BASELINE_MEASURED.json): dots_attn_saveable 192.9 >
+    # dots_saveable 190.2 > none_saveable ~183 img/s/chip — bench selects
+    # dots_attn_saveable where activations fit.
+    remat_policy: str = "none_saveable" # none_saveable | dots_saveable | dots_attn_saveable (only if grad_ckpt)
     profile_dir: str = ""               # if set, capture a jax.profiler trace of a few steps
     debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
     log_memory: bool = True             # include HBM stats in step log
@@ -151,7 +155,7 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
     ext.add_argument("--host_normalize", action="store_false", dest="device_normalize")
     ext.add_argument("--remat_policy", type=str, default=Config.remat_policy,
-                     choices=["none_saveable", "dots_saveable"])
+                     choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
     ext.add_argument("--profile_dir", type=str, default="")
     ext.add_argument("--debug_nans", action="store_true", dest="debug_nans")
     ext.add_argument("--no_log_memory", action="store_false", dest="log_memory")

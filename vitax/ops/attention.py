@@ -105,21 +105,25 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dlse_ref,
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
     p = jnp.exp(s - lse)  # softmax probabilities, (N, N) f32
 
+    # Matmul operands go in the INPUT dtype (bf16 under training) with f32
+    # accumulation — f32 operands would run the MXU at half rate on v5e+
+    # (profiled: the all-f32 version of this kernel was ~1.5x slower on l14);
+    # softmax/score math above stays f32 for stability. With f32 inputs (tests)
+    # the casts are no-ops and numerics are unchanged.
+    pb = p.astype(q_ref.dtype)
+    dob = do.astype(q_ref.dtype)
     dv = jax.lax.dot_general(  # P^T dO
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        pb, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(  # dO V^T
-        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (N, 1)
+        dob, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (N, 1) f32
     # d lse_i / d s_ij = p_ij, so the lse cotangent adds dlse_i inside the parens
-    ds = p * (dp - delta + dlse) * scale
+    ds = (p * (dp - delta + dlse) * scale).astype(q_ref.dtype)
 
     dq = jax.lax.dot_general(
-        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dk = jax.lax.dot_general(  # dS^T Q
-        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     dq_ref[0] = dq.astype(dq_ref.dtype)
     dk_ref[0] = dk.astype(dk_ref.dtype)
@@ -176,6 +180,179 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return o.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
 
 
+# ---------------------------------------------------------------------------
+# 4D-native kernel: operates directly on (B, N, H, Dh) — no HBM transposes
+# ---------------------------------------------------------------------------
+# The BH kernels above need (B, N, H, Dh) -> (B*H, N, Dh) relayouts around
+# every call; profiled at ~16 ms/step of pure HBM copies on ViT-L/14 v5e
+# ("data formatting"). Here the operands are viewed as (B, N, H*Dh) — a free
+# bitcast — the grid is (batch,), and each head is a static LANE slice of the
+# block. Scores are computed in TRANSPOSED space (sT = K Q^T) so the per-head
+# logsumexp is a (1, N) row — every slice/store stays a legal Mosaic layout
+# (no vector transposes, no mid-tensor unit reshapes; probed 13% faster than
+# the BH path forward on v5e).
+
+
+def _fwd4_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, heads, scale):
+    dh = q_ref.shape[-1] // heads
+    lse_rows = []
+    for i in range(heads):  # static unroll: one (N, Dh) head per iteration
+        q = q_ref[0][:, i * dh:(i + 1) * dh]
+        k = k_ref[0][:, i * dh:(i + 1) * dh]
+        v = v_ref[0][:, i * dh:(i + 1) * dh]
+        sT = jax.lax.dot_general(  # (Nk, Nq)
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        m = jnp.max(sT, axis=0, keepdims=True)       # (1, Nq)
+        p = jnp.exp(sT - m)
+        l = jnp.sum(p, axis=0, keepdims=True)        # (1, Nq)
+        o = jax.lax.dot_general(                     # (Nq, Dh)
+            (p / l).astype(v.dtype), v, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[0, :, i * dh:(i + 1) * dh] = o.astype(o_ref.dtype)
+        lse_rows.append(m + jnp.log(l))
+    lse_ref[0] = jnp.concatenate(lse_rows, axis=0)   # (H, Nq)
+
+
+def _bwd4_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dlse_ref,
+                 dq_ref, dk_ref, dv_ref, *, heads, scale):
+    dh = q_ref.shape[-1] // heads
+    ones_row = jnp.ones((1, dh), jnp.float32)
+    for i in range(heads):
+        sl = slice(i * dh, (i + 1) * dh)
+        q = q_ref[0][:, sl]                          # (Nq, Dh), input dtype
+        k = k_ref[0][:, sl]
+        v = v_ref[0][:, sl]
+        o = o_ref[0][:, sl].astype(jnp.float32)
+        do = do_ref[0][:, sl].astype(jnp.float32)
+        lse_row = lse_ref[0][i:i + 1, :]             # (1, Nq) f32
+        dlse_row = dlse_ref[0][i:i + 1, :]
+
+        sT = jax.lax.dot_general(                    # (Nk, Nq)
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        pT = jnp.exp(sT - lse_row)
+
+        # matmuls take operands in the INPUT dtype with f32 accumulation —
+        # f32 operands would run the MXU at half rate on v5e+; softmax/score
+        # math stays f32 (with f32 inputs the casts are no-ops, so the
+        # numerics tests compare exactly)
+        pTb = pT.astype(q_ref.dtype)
+        dob = do.astype(q_ref.dtype)
+        dv = jax.lax.dot_general(                    # P^T dO: contract Nq
+            pTb, dob, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (Nk, Dh)
+        dpT = jax.lax.dot_general(                   # V dO^T: contract Dh
+            v, dob, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (Nk, Nq)
+        delta_row = jax.lax.dot_general(             # sum(dO*O, -1) as a row
+            ones_row, do * o, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (1, Nq)
+        dsT = (pT * (dpT - delta_row + dlse_row) * scale).astype(q_ref.dtype)
+
+        dq = jax.lax.dot_general(                    # dS K: contract Nk
+            dsT, k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (Nq, Dh)
+        dk = jax.lax.dot_general(                    # dS^T Q: contract Nq
+            dsT, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (Nk, Dh)
+
+        dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
+        dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
+
+
+# VMEM working-set estimate per program for the backward kernel (the larger
+# one): 10 double-buffered (N, hb*Dh) blocks + per-head f32 score temps. The
+# budget leaves Mosaic headroom of the ~16 MB/core.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _heads_per_program(n: int, h: int, dh: int, itemsize: int):
+    """Head-group size: largest legal divisor of h fitting the VMEM budget,
+    or None when no group does (the caller must then route the BH kernel).
+    Legal = the lane dim of the (1, N, hb*Dh) block is a multiple of 128, or
+    the group is all of h (block == full array dims)."""
+    for hb in range(h, 0, -1):
+        if h % hb or not (hb == h or (hb * dh) % 128 == 0):
+            continue
+        est = 2 * 10 * n * hb * dh * itemsize + 4 * n * n * 4
+        if est <= _VMEM_BUDGET:
+            return hb
+    return None  # even hb=1 busts the budget (large n: score temps dominate)
+
+
+def flash4_supported(n: int, h: int, dh: int, itemsize: int) -> bool:
+    """Whether the 4D-native kernel has a legal, VMEM-fitting head grouping
+    for this shape — checked by _tpu_kernel before selecting it; the BH
+    (relayout) kernel is the fallback (its per-(b,h) program holds ONE f32
+    (N, N) score temp, so it survives to larger N)."""
+    return _heads_per_program(n, h, dh, itemsize) is not None
+
+
+def _fwd4(q, k, v, scale):
+    b, n, h, dh = q.shape
+    hb = _heads_per_program(n, h, dh, q.dtype.itemsize)
+    assert hb is not None, (
+        f"flash_attention_4d has no VMEM-fitting head grouping for "
+        f"(n={n}, h={h}, dh={dh}) — gate on flash4_supported() first")
+    q3, k3, v3 = (x.reshape(b, n, h * dh) for x in (q, k, v))  # free bitcasts
+    spec = pl.BlockSpec((1, n, hb * dh), lambda i, j: (i, 0, j))
+    lse_spec = pl.BlockSpec((1, hb, n), lambda i, j: (i, j, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd4_kernel, heads=hb, scale=scale),
+        grid=(b, h // hb),
+        in_specs=[spec, spec, spec],
+        out_specs=[spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, h * dh), q.dtype),
+            jax.ShapeDtypeStruct((b, h, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return o.reshape(b, n, h, dh), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash4_with_lse(q, k, v, scale):
+    """(B, N, H, Dh) fused attention returning (o, lse (B, H, N));
+    differentiable in both outputs (lse cotangent as in flash_bh_with_lse)."""
+    return _fwd4(q, k, v, scale)
+
+
+def _flash4_fwd(q, k, v, scale):
+    o, lse = _fwd4(q, k, v, scale)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash4_bwd(scale, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    b, n, h, dh = q.shape
+    hb = _heads_per_program(n, h, dh, q.dtype.itemsize)
+    flat = (x.reshape(b, n, h * dh) for x in (q, k, v, o, do))
+    q3, k3, v3, o3, do3 = flat
+    spec = pl.BlockSpec((1, n, hb * dh), lambda i, j: (i, 0, j))
+    lse_spec = pl.BlockSpec((1, hb, n), lambda i, j: (i, j, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd4_kernel, heads=hb, scale=scale),
+        grid=(b, h // hb),
+        in_specs=[spec, spec, spec, spec, lse_spec, spec, lse_spec],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((b, n, h * dh), q.dtype)] * 3,
+        interpret=_interpret(),
+    )(q3, k3, v3, o3, lse, do3, dlse)
+    return tuple(x.reshape(b, n, h, dh) for x in (dq, dk, dv))
+
+
+flash4_with_lse.defvjp(_flash4_fwd, _flash4_bwd)
+
+
+def flash_attention_4d(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Fused attention on native (B, N, H, Dh) layout — no HBM relayouts."""
+    return flash4_with_lse(q, k, v, q.shape[-1] ** -0.5)[0]
+
+
 def _named(fn, name: str):
     """Tag an attention impl with a human-readable name for the startup log
     (shard_map outputs don't take attribute assignment, so wrap)."""
@@ -185,22 +362,37 @@ def _named(fn, name: str):
     return impl
 
 
-def _tpu_kernel(cfg, n: int):
+def _tpu_kernel(cfg, n: int, force: bool = False, local_heads: int = 0):
     """(kernel, name) for full-sequence attention on this platform, or
     (None, None) when only the dense jnp path applies. The single source of
-    the use_flash_attention / platform / VMEM-threshold policy."""
+    the use_flash_attention / platform / VMEM-threshold policy.
+
+    force=True skips the platform check (kernels run in Pallas interpret mode
+    off-TPU) — used by the multichip dryrun so it exercises exactly this
+    selection logic on the CPU mesh. local_heads is the PER-SHARD head count
+    the kernel will actually see (num_heads/tp under shard_map, /(sp*tp)
+    under Ulysses) — 4D-kernel support must be judged on that, not the
+    global count."""
     if not cfg.use_flash_attention:
         return None, None
-    if jax.devices()[0].platform != "tpu":
+    if not force and jax.devices()[0].platform != "tpu":
         return None, None
     if n > MAX_SEQ_IN_VMEM:
         # streaming kernel: VMEM use independent of N (vitax/ops/flash_blocked.py)
         from vitax.ops.flash_blocked import blocked_flash_attention
         return blocked_flash_attention, "pallas streaming (blocked)"
-    return flash_attention, "pallas fused (whole-N)"
+    h = local_heads or cfg.num_heads
+    dh = cfg.embed_dim // cfg.num_heads
+    itemsize = 2 if cfg.dtype == "bfloat16" else 4
+    if flash4_supported(n, h, dh, itemsize):
+        return flash_attention_4d, "pallas fused (4D whole-N)"
+    # no legal VMEM-fitting head grouping (large N x D): the BH kernel's
+    # per-(b,h) program holds a single (N, N) score temp and still fits
+    return flash_attention, "pallas fused (whole-N, BH relayout)"
 
 
-def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
+def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
+                        force_tpu_kernels: bool = False):
     """Choose the attention core for this config/mesh:
 
     - sp > 1: sequence parallelism — ring attention (default), or Ulysses
@@ -209,8 +401,25 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
     - TPU: the whole-N fused Pallas kernel, or the streaming (blocked) kernel
       beyond MAX_SEQ_IN_VMEM (shard_map-wrapped on multi-device meshes)
     - otherwise: None -> dense jnp path (GSPMD still shards batch/heads)
+
+    force_tpu_kernels=True makes the same selections off-TPU with the Pallas
+    kernels in interpret mode (the multichip dryrun's production-path sweep).
+
+    NOTE: the fused kernels have no dropout hook, so with --att_dropout > 0
+    *training* steps route through the dense O(N^2) path regardless of the
+    impl returned here (vitax/models/vit.py Attention.__call__); eval remains
+    on the kernel. That silent perf cliff is warned about loudly below.
     """
     n = cfg.num_patches
+
+    if cfg.use_flash_attention and cfg.att_dropout > 0.0:
+        from vitax.utils.logging import master_print
+        master_print(
+            f"WARNING: --att_dropout {cfg.att_dropout} > 0 disables the fused "
+            f"attention kernel for training steps (the Pallas kernels have no "
+            f"dropout hook) — training falls back to the dense O(N^2) "
+            f"attention path; eval still uses the kernel. Set --att_dropout 0 "
+            f"to keep the fused path (the reference default).")
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
 
@@ -222,7 +431,8 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
                 # all-to-all head<->token resharding; the inner kernel sees
                 # the full sequence, so the Pallas cores apply on TPU
                 from vitax.parallel.ulysses import make_ulysses_attention
-                inner, _ = _tpu_kernel(cfg, n)
+                inner, _ = _tpu_kernel(cfg, n, force=force_tpu_kernels,
+                                       local_heads=cfg.num_heads // (sp * tp))
                 return _named(make_ulysses_attention(mesh, inner),
                               "ulysses all-to-all (sp)")
             from vitax.utils.logging import master_print
@@ -233,19 +443,23 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None):
         from vitax.parallel.ring_attention import make_ring_attention
         # local block product through the Pallas kernels on TPU (whole-N or
         # streaming by local length), dense jnp when kernels are disabled
-        use_kernel = None if cfg.use_flash_attention else False
+        if not cfg.use_flash_attention:
+            use_kernel = False
+        else:
+            use_kernel = True if force_tpu_kernels else None  # None = on-TPU
         return _named(make_ring_attention(mesh, use_kernel=use_kernel),
                       "ring attention (sp)")
 
-    kernel, name = _tpu_kernel(cfg, n)
+    if mesh is not None and mesh.size > 1 and cfg.num_heads % tp != 0:
+        return None
+    # under shard_map the kernel sees num_heads/tp heads per shard
+    kernel, name = _tpu_kernel(cfg, n, force=force_tpu_kernels,
+                               local_heads=cfg.num_heads // tp)
     if kernel is None:
         return None
 
     if mesh is None or mesh.size == 1:
         return _named(kernel, name)
-
-    if cfg.num_heads % tp != 0:
-        return None
     spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
     return _named(jax.shard_map(
         kernel, mesh=mesh,
