@@ -135,10 +135,12 @@ def train_presets(n_dev: int) -> dict:
                     num_blocks=24, batch_size=32 * n_dev),
         "10b": dict(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
                     num_blocks=32, batch_size=8 * n_dev),
-        # largest 10B-family slice that fits one v5e chip: same 5120-dim blocks,
-        # depth cut to 4 so params+moments+activations stay under 16 GB HBM
+        # largest 10B-family slice that fits one v5e chip: same 5120-dim
+        # blocks, depth cut to 2. Depth 4 does NOT fit — measured 15.2 GB f32
+        # state + 10.2 GB temps (tests/test_memory_analysis.py::
+        # test_10b_slice_fits_single_chip_hbm holds the preset to the limit).
         "10b_slice": dict(image_size=224, patch_size=14, embed_dim=5120,
-                          num_heads=32, num_blocks=4, batch_size=8 * n_dev),
+                          num_heads=32, num_blocks=2, batch_size=8 * n_dev),
     }
 
 
