@@ -335,6 +335,8 @@ def test_10b_slice_fits_single_chip_hbm(devices8):
         f"10b_slice single-chip resident {resident/1e9:.2f} GB exceeds v5e "
         f"HBM (args {ma.argument_size_in_bytes/1e9:.2f} + temps "
         f"{ma.temp_size_in_bytes/1e9:.2f} + unaliased out "
-        f"{unaliased_out/1e9:.2f} — nonzero means state donation broke)")
+        f"{unaliased_out/1e9:.2f} — small metrics outputs are expected here; "
+        f"a STATE-SIZED value (~{_state_bytes(state)/1e9:.1f} GB) means "
+        f"donation broke)")
     # arguments alone are the f32 state: params + 2 AdamW moments + batch
     assert ma.argument_size_in_bytes > 0.9 * _state_bytes(state)

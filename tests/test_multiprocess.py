@@ -26,29 +26,37 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+
+def _tiny_train_argv(steps_per_epoch, ckpt_dir):
+    return [sys.executable, "run_vit_training.py", "--fake_data",
+            "--image_size", "32", "--patch_size", "8", "--embed_dim", "32",
+            "--num_heads", "2", "--num_blocks", "2", "--num_classes", "4",
+            "--batch_size", "16", "--dtype", "float32", "--num_epochs", "1",
+            "--steps_per_epoch", str(steps_per_epoch),
+            "--log_step_interval", "1", "--warmup_steps", "0",
+            "--eval_max_batches", "1", "--test_epoch_interval", "99",
+            "--ckpt_epoch_interval", "99", "--ckpt_dir", str(ckpt_dir)]
+
+
+def _two_proc_env(port, pid):
+    return dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
+        JAX_NUM_PROCESSES="2",
+        JAX_PROCESS_ID=str(pid),
+    )
+
+
 @pytest.mark.slow
 def test_two_process_training(tmp_path):
     port = _free_port()
     procs = []
     for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
-            JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
-            JAX_NUM_PROCESSES="2",
-            JAX_PROCESS_ID=str(pid),
-        )
         procs.append(subprocess.Popen(
-            [sys.executable, "run_vit_training.py", "--fake_data",
-             "--image_size", "32", "--patch_size", "8", "--embed_dim", "32",
-             "--num_heads", "2", "--num_blocks", "2", "--num_classes", "4",
-             "--batch_size", "16", "--dtype", "float32", "--num_epochs", "1",
-             "--steps_per_epoch", "3", "--log_step_interval", "1",
-             "--warmup_steps", "0", "--eval_max_batches", "1",
-             "--test_epoch_interval", "99", "--ckpt_epoch_interval", "99",
-             "--ckpt_dir", str(tmp_path / "ckpt")],
-            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            _tiny_train_argv(3, tmp_path / "ckpt"),
+            cwd=REPO, env=_two_proc_env(port, pid), stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
     outs = []
     try:
@@ -76,3 +84,53 @@ def test_two_process_training(tmp_path):
     losses = re.findall(r"loss: ([0-9.]+)", log)
     assert losses, log[-2000:]
     assert all(float(x) > 0 for x in losses)
+
+
+@pytest.mark.slow
+def test_two_process_preemption_agreement(tmp_path):
+    """SIGTERM delivered to ONLY rank 1 must stop BOTH processes at an agreed
+    step with a committed preemption checkpoint — the collective flag sync in
+    vitax/train/loop.py (_preempt_agreed). Without agreement, rank 1 entering
+    the save while rank 0 keeps stepping would deadlock the pod."""
+    import signal
+    import time
+
+    port = _free_port()
+    logs = [tmp_path / f"rank{i}.log" for i in range(2)]
+    procs = []
+    for pid in range(2):
+        with open(logs[pid], "w") as log_f:  # child holds its own dup'd fd
+            procs.append(subprocess.Popen(
+                _tiny_train_argv(2000, tmp_path / "ckpt"),
+                cwd=REPO, env=_two_proc_env(port, pid), stdout=log_f,
+                stderr=subprocess.STDOUT, text=True))
+    try:
+        # wait until rank 0 logs a training step, then SIGTERM rank 1 ONLY
+        deadline = time.time() + 540
+        while time.time() < deadline:
+            if "step 1," in logs[0].read_text():
+                break
+            if any(p.poll() is not None for p in procs):
+                raise AssertionError(
+                    f"a process died early:\n{logs[0].read_text()[-2000:]}\n"
+                    f"{logs[1].read_text()[-2000:]}")
+            time.sleep(1)
+        else:
+            raise AssertionError("rank 0 never reached step 1: "
+                                 + logs[0].read_text()[-2000:])
+        procs[1].send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait(timeout=300)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    out0 = logs[0].read_text()
+    assert procs[0].returncode == 0, out0[-3000:]
+    assert procs[1].returncode == 0, logs[1].read_text()[-3000:]
+    # rank 0 never saw the signal locally, yet announces the agreed stop
+    assert "SIGTERM received: saving preemption checkpoint" in out0, out0[-3000:]
+    assert (tmp_path / "ckpt" / "epoch_1").is_dir()
+    assert "training completed" in out0  # clean exit path, not a crash
