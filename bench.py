@@ -273,7 +273,7 @@ def bench_train(args, metric_stub: str) -> None:
     if args.remat_policy is None:
         args.remat_policy = default_remat_policy(args.preset)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
-                 grad_ckpt=args.grad_ckpt,
+                 grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  use_flash_attention=args.use_flash_attention, **kw).validate()
 
     mesh = build_mesh(cfg)
@@ -325,6 +325,11 @@ def bench_train(args, metric_stub: str) -> None:
             "n_devices": n_dev,
             "batch_size": cfg.batch_size,
             "remat_policy": cfg.remat_policy,
+            # record every A/B knob so an experiment run can never
+            # masquerade as the default-config baseline in the JSON
+            "scan_blocks": cfg.scan_blocks,
+            "grad_ckpt": cfg.grad_ckpt,
+            "use_flash_attention": cfg.use_flash_attention,
         })
 
     emit({
@@ -348,6 +353,9 @@ def main():
     p.add_argument("--remat_policy", default=None,
                    choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
     p.add_argument("--no_grad_ckpt", action="store_false", dest="grad_ckpt")
+    p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
+                   help="unroll blocks instead of lax.scan (A/B: the scan's "
+                        "dus-stacking constrains wgrad fusion layouts)")
     p.add_argument("--no_flash_attention", action="store_false",
                    dest="use_flash_attention")
     p.add_argument("--steps", type=int, default=30)

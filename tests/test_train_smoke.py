@@ -38,17 +38,23 @@ def random_batch(cfg, mesh, seed=0):
             "label": jax.device_put(jnp.asarray(labels), sh)}
 
 
-def run_steps(cfg, n_steps=8, seed=0):
-    """Build the full sharded step exactly as the training loop does
-    (attention impl + token sharding selection included) and run n steps."""
+def build_train_objects(cfg, max_iteration=100):
+    """Build the full sharded training machinery exactly as the training loop
+    does (attention impl + token sharding selection included)."""
     from vitax.ops.attention import make_attention_impl
     from vitax.train.loop import _token_sharding
     mesh = build_mesh(cfg)
     model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh),
                         token_sharding=_token_sharding(cfg, mesh))
-    tx, schedule = build_optimizer(cfg, max_iteration=100)
+    tx, _ = build_optimizer(cfg, max_iteration=max_iteration)
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(cfg.seed))
     step_fn = make_train_step(cfg, model, tx, mesh, sspecs)
+    eval_fn = make_eval_step(cfg, model, mesh, sspecs)
+    return mesh, state, step_fn, eval_fn
+
+
+def run_steps(cfg, n_steps=8, seed=0):
+    mesh, state, step_fn, _ = build_train_objects(cfg)
     rng = jax.random.key(cfg.seed + 1)
     losses = []
     for i in range(n_steps):
@@ -193,3 +199,39 @@ def test_sigterm_preemption_save(devices8, tmp_path):
     )
     state2 = train(cfg2)
     assert int(jax.device_get(state2.step)) == 3  # 1 saved + epoch-2's 2 steps
+
+
+@pytest.mark.slow
+def test_model_actually_learns(devices8):
+    """Beyond loss-decreases: on a linearly-separable synthetic task (class =
+    dominant color channel) the full sharded train step must reach high train
+    accuracy from random init — end-to-end learning evidence (model + loss +
+    optimizer + schedule + sharding all correct together), not just a falling
+    scalar."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from vitax.parallel.mesh import batch_pspec
+
+    cfg = tiny_cfg(num_classes=3, batch_size=32, lr=3e-3, warmup_steps=5)
+    mesh, state, step_fn, eval_fn = build_train_objects(cfg, max_iteration=200)
+    sh = NamedSharding(mesh, batch_pspec())
+
+    def color_batch(seed):
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, 3, size=(cfg.batch_size,))
+        imgs = rng.normal(0, 0.3, size=(
+            cfg.batch_size, cfg.image_size, cfg.image_size, 3))
+        for i, c in enumerate(labels):
+            imgs[i, :, :, c] += 2.0  # dominant channel = class
+        return {"image": jax.device_put(jnp.asarray(imgs, jnp.float32), sh),
+                "label": jax.device_put(jnp.asarray(labels, jnp.int32), sh)}
+
+    rng_key = jax.random.key(1)
+    for i in range(60):
+        state, metrics = step_fn(state, color_batch(i), rng_key)
+
+    # held-out batches (seeds never trained on)
+    correct = sum(int(jax.device_get(eval_fn(state, color_batch(1000 + j))))
+                  for j in range(4))
+    accuracy = correct / (4 * cfg.batch_size)
+    assert accuracy > 0.9, f"model failed to learn a separable task: {accuracy=}"
