@@ -195,12 +195,17 @@ def test_flash4d_odd_head_count(devices8):
 
 
 def test_flash4d_head_grouping(devices8):
-    """10B-family dims (h*dh too big for one VMEM block) split into head
-    groups; numerics must be identical to the dense reference."""
+    """Shapes whose full head set busts the VMEM budget split into head
+    groups; numerics must be identical to the dense reference. Partial
+    groupings must satisfy BOTH Mosaic tiling rules (lane: hb*Dh % 128,
+    sublane of the lse block: hb % 8) — the round-3 chip run caught an
+    hb=4 pick that interpret mode had green-lit. The 10B-family dims
+    (h=32, dh=160) have NO legal fitting grouping and must route the BH
+    kernel instead (hb=8 needs ~14 MB > the 12 MB budget)."""
     from vitax.ops.attention import _heads_per_program, flash_attention_4d
-    assert _heads_per_program(256, 32, 160, 2) < 32  # flagship splits
-    shape = (1, 128, 16, 160)
-    assert _heads_per_program(128, 16, 160, 4) < 16  # this test's shape splits
+    assert _heads_per_program(256, 32, 160, 2) is None  # flagship -> BH
+    shape = (1, 256, 16, 64)  # f32: full set needs ~21 MB -> splits to hb=8
+    assert _heads_per_program(256, 16, 64, 4) == 8
     kq, kk, kv = jax.random.split(jax.random.key(6), 3)
     q = jax.random.normal(kq, shape, jnp.float32)
     k = jax.random.normal(kk, shape, jnp.float32)
@@ -227,15 +232,15 @@ def test_tpu_kernel_selection_uses_local_heads(devices8):
     from vitax.ops.attention import (_tpu_kernel, flash4_supported,
                                      flash_attention, flash_attention_4d)
 
-    # n=729, dh=64, bf16: global h=12 has a legal grouping (hb=2? -> actually
-    # any hb with (hb*64)%128==0), local h=3 has none (hb=3 busts budget,
-    # hb=1/2 illegal)
-    assert flash4_supported(729, 12, 64, 2)
-    assert not flash4_supported(729, 3, 64, 2)
-    cfg = Config(image_size=216, patch_size=8, embed_dim=768, num_heads=12,
+    # n=400, dh=64, bf16: global h=24 has a legal grouping (hb=8 fits the
+    # VMEM budget), local h=12 has none (hb=12 full-array busts the budget,
+    # hb=8 is not a divisor, smaller hb fails the sublane rule)
+    assert flash4_supported(400, 24, 64, 2)
+    assert not flash4_supported(400, 12, 64, 2)
+    cfg = Config(image_size=160, patch_size=8, embed_dim=1536, num_heads=24,
                  num_blocks=1, dtype="bfloat16").validate()
     k_global, _ = _tpu_kernel(cfg, cfg.num_patches, force=True)
     k_local, name = _tpu_kernel(cfg, cfg.num_patches, force=True,
-                                local_heads=3)
+                                local_heads=12)
     assert k_global is flash_attention_4d
     assert k_local is flash_attention and "BH relayout" in name

@@ -275,10 +275,13 @@ _VMEM_BUDGET = 12 * 1024 * 1024
 def _heads_per_program(n: int, h: int, dh: int, itemsize: int):
     """Head-group size: largest legal divisor of h fitting the VMEM budget,
     or None when no group does (the caller must then route the BH kernel).
-    Legal = the lane dim of the (1, N, hb*Dh) block is a multiple of 128, or
-    the group is all of h (block == full array dims)."""
+    Legal = full-array blocks (hb == h), or BOTH Mosaic tiling rules hold for
+    a partial grid: the q/k/v/o block's lane dim hb*Dh is a multiple of 128
+    AND the lse block's (1, hb, N) sublane dim hb is a multiple of 8. (The
+    sublane rule only bites on real TPU — interpret-mode tests pass without
+    it, which is how the hb=4 pick for h=32/dh=160 slipped through.)"""
     for hb in range(h, 0, -1):
-        if h % hb or not (hb == h or (hb * dh) % 128 == 0):
+        if h % hb or not (hb == h or ((hb * dh) % 128 == 0 and hb % 8 == 0)):
             continue
         est = 2 * 10 * n * hb * dh * itemsize + 4 * n * n * 4
         if est <= _VMEM_BUDGET:
