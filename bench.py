@@ -139,8 +139,11 @@ def train_presets(n_dev: int) -> dict:
         # blocks, depth cut to 2. Depth 4 does NOT fit — measured 15.2 GB f32
         # state + 10.2 GB temps (tests/test_memory_analysis.py::
         # test_10b_slice_fits_single_chip_hbm holds the preset to the limit).
+        # Batch 64/chip is the measured single-chip throughput frontier
+        # (MFU 0.579 on v5e; 96 OOMs — see BASELINE.md's frontier table; the
+        # flagship's pod operating point of 8/chip measures 73-79 img/s).
         "10b_slice": dict(image_size=224, patch_size=14, embed_dim=5120,
-                          num_heads=32, num_blocks=2, batch_size=8 * n_dev),
+                          num_heads=32, num_blocks=2, batch_size=64 * n_dev),
     }
 
 

@@ -194,19 +194,9 @@ def test_flash4d_odd_head_count(devices8):
         np.asarray(reference_attention(q, k, v)), rtol=2e-4, atol=2e-4)
 
 
-def test_flash4d_head_grouping(devices8):
-    """Shapes whose full head set busts the VMEM budget split into head
-    groups; numerics must be identical to the dense reference. Partial
-    groupings must satisfy BOTH Mosaic tiling rules (lane: hb*Dh % 128,
-    sublane of the lse block: hb % 8) — the round-3 chip run caught an
-    hb=4 pick that interpret mode had green-lit. The 10B-family dims
-    (h=32, dh=160) have NO legal fitting grouping and must route the BH
-    kernel instead (hb=8 needs ~14 MB > the 12 MB budget)."""
-    from vitax.ops.attention import _heads_per_program, flash_attention_4d
-    assert _heads_per_program(256, 32, 160, 2) is None  # flagship -> BH
-    shape = (1, 256, 16, 64)  # f32: full set needs ~21 MB -> splits to hb=8
-    assert _heads_per_program(256, 16, 64, 4) == 8
-    kq, kk, kv = jax.random.split(jax.random.key(6), 3)
+def _check_flash4d_matches_reference(shape, seed):
+    from vitax.ops.attention import flash_attention_4d
+    kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
     q = jax.random.normal(kq, shape, jnp.float32)
     k = jax.random.normal(kk, shape, jnp.float32)
     v = jax.random.normal(kv, shape, jnp.float32)
@@ -224,6 +214,33 @@ def test_flash4d_head_grouping(devices8):
                                    rtol=1e-3, atol=1e-3)
 
 
+def test_flash4d_head_grouping(devices8):
+    """Shapes whose full head set busts the VMEM budget split into head
+    groups; numerics must be identical to the dense reference. Groupings
+    whose sublane count is legal (hb % 8 == 0) use the plain (B, H, N) lse
+    layout; no padding involved."""
+    from vitax.ops.attention import _heads_per_program, _lse_pad_rows
+    shape = (1, 256, 16, 64)  # f32: full set needs ~21 MB -> splits to hb=8
+    assert _heads_per_program(256, 16, 64, 4) == 8
+    assert _lse_pad_rows(8, 16) == 0
+    _check_flash4d_matches_reference(shape, seed=6)
+
+
+def test_flash4d_padded_lse_grouping(devices8):
+    """Groupings with hb % 8 != 0 (the 10B family: h=32, dh=160 -> hb=4)
+    store lse in the grouped-padded (B, H/hb, 8, N) layout so every block
+    satisfies Mosaic's sublane rule — the layout that keeps the 4D kernel
+    (640-lane blocks, no (8,128)-tile padding) on the flagship shapes where
+    the BH kernel's Dh=160 operands pad 1.6x in HBM. Numerics must match
+    the dense reference through fwd AND the padded-lse backward."""
+    from vitax.ops.attention import _heads_per_program, _lse_pad_rows
+    assert _heads_per_program(256, 32, 160, 2) == 4   # flagship, bf16
+    assert _lse_pad_rows(4, 32) == 8
+    # f32 version of the same head geometry at n=128 picks hb=4 too
+    assert _heads_per_program(128, 32, 160, 4) == 4
+    _check_flash4d_matches_reference((1, 128, 32, 160), seed=7)
+
+
 def test_tpu_kernel_selection_uses_local_heads(devices8):
     """Under tp, the shard_map'd kernel sees num_heads/tp heads — 4D-kernel
     support must be judged on the LOCAL count, falling back to the BH kernel
@@ -232,12 +249,13 @@ def test_tpu_kernel_selection_uses_local_heads(devices8):
     from vitax.ops.attention import (_tpu_kernel, flash4_supported,
                                      flash_attention, flash_attention_4d)
 
-    # n=400, dh=64, bf16: global h=24 has a legal grouping (hb=8 fits the
-    # VMEM budget), local h=12 has none (hb=12 full-array busts the budget,
-    # hb=8 is not a divisor, smaller hb fails the sublane rule)
-    assert flash4_supported(400, 24, 64, 2)
-    assert not flash4_supported(400, 12, 64, 2)
-    cfg = Config(image_size=160, patch_size=8, embed_dim=1536, num_heads=24,
+    # n=324, dh=80, bf16: global h=24 has a legal grouping (hb=8: lane
+    # 8*80=640 % 128 == 0, fits the VMEM budget), local h=12 has none
+    # (hb=12 full-array busts the budget; every proper divisor's lane dim
+    # hb*80 is not a multiple of 128)
+    assert flash4_supported(324, 24, 80, 2)
+    assert not flash4_supported(324, 12, 80, 2)
+    cfg = Config(image_size=144, patch_size=8, embed_dim=1920, num_heads=24,
                  num_blocks=1, dtype="bfloat16").validate()
     k_global, _ = _tpu_kernel(cfg, cfg.num_patches, force=True)
     k_local, name = _tpu_kernel(cfg, cfg.num_patches, force=True,

@@ -197,7 +197,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 # the BH path forward on v5e).
 
 
-def _fwd4_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, heads, scale):
+def _fwd4_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, heads, scale,
+                 pad_rows):
     dh = q_ref.shape[-1] // heads
     lse_rows = []
     for i in range(heads):  # static unroll: one (N, Dh) head per iteration
@@ -215,11 +216,16 @@ def _fwd4_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, heads, scale):
             preferred_element_type=jnp.float32)
         o_ref[0, :, i * dh:(i + 1) * dh] = o.astype(o_ref.dtype)
         lse_rows.append(m + jnp.log(l))
-    lse_ref[0] = jnp.concatenate(lse_rows, axis=0)   # (H, Nq)
+    if pad_rows:  # grouped-padded layout: block is (1, 1, P, Nq)
+        n = q_ref.shape[1]
+        lse_rows.append(jnp.zeros((pad_rows - heads, n), jnp.float32))
+        lse_ref[0, 0] = jnp.concatenate(lse_rows, axis=0)  # (P, Nq)
+    else:
+        lse_ref[0] = jnp.concatenate(lse_rows, axis=0)     # (H, Nq)
 
 
 def _bwd4_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dlse_ref,
-                 dq_ref, dk_ref, dv_ref, *, heads, scale):
+                 dq_ref, dk_ref, dv_ref, *, heads, scale, pad_rows):
     dh = q_ref.shape[-1] // heads
     ones_row = jnp.ones((1, dh), jnp.float32)
     for i in range(heads):
@@ -229,8 +235,10 @@ def _bwd4_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dlse_ref,
         v = v_ref[0][:, sl]
         o = o_ref[0][:, sl].astype(jnp.float32)
         do = do_ref[0][:, sl].astype(jnp.float32)
-        lse_row = lse_ref[0][i:i + 1, :]             # (1, Nq) f32
-        dlse_row = dlse_ref[0][i:i + 1, :]
+        lse_blk = lse_ref[0, 0] if pad_rows else lse_ref[0]
+        dlse_blk = dlse_ref[0, 0] if pad_rows else dlse_ref[0]
+        lse_row = lse_blk[i:i + 1, :]                # (1, Nq) f32
+        dlse_row = dlse_blk[i:i + 1, :]
 
         sT = jax.lax.dot_general(                    # (Nk, Nq)
             k, q, (((1,), (1,)), ((), ())),
@@ -275,18 +283,30 @@ _VMEM_BUDGET = 12 * 1024 * 1024
 def _heads_per_program(n: int, h: int, dh: int, itemsize: int):
     """Head-group size: largest legal divisor of h fitting the VMEM budget,
     or None when no group does (the caller must then route the BH kernel).
-    Legal = full-array blocks (hb == h), or BOTH Mosaic tiling rules hold for
-    a partial grid: the q/k/v/o block's lane dim hb*Dh is a multiple of 128
-    AND the lse block's (1, hb, N) sublane dim hb is a multiple of 8. (The
-    sublane rule only bites on real TPU — interpret-mode tests pass without
-    it, which is how the hb=4 pick for h=32/dh=160 slipped through.)"""
+    Legal = full-array blocks (hb == h), or the q/k/v/o block's lane dim
+    hb*Dh is a multiple of 128 for a partial grid. Mosaic's OTHER tiling
+    rule — the lse block's sublane dim must be a multiple of 8 — is
+    satisfied by layout, not selection: groupings with hb % 8 != 0 store
+    lse in the grouped-padded (B, H/hb, P, N) layout (_lse_pad_rows), whose
+    (1, 1, P, N) blocks are always legal. (The sublane rule only bites on
+    real TPU — interpret mode green-lit an illegal (1, 4, 256) lse block
+    for h=32/dh=160, which the first on-chip 10b_slice compile caught.)"""
     for hb in range(h, 0, -1):
-        if h % hb or not (hb == h or ((hb * dh) % 128 == 0 and hb % 8 == 0)):
+        if h % hb or not (hb == h or (hb * dh) % 128 == 0):
             continue
         est = 2 * 10 * n * hb * dh * itemsize + 4 * n * n * 4
         if est <= _VMEM_BUDGET:
             return hb
     return None  # even hb=1 busts the budget (large n: score temps dominate)
+
+
+def _lse_pad_rows(hb: int, h: int) -> int:
+    """Sublane padding P for the lse blocks of an hb-head grouping; 0 means
+    the plain (B, H, N) layout with (1, hb, N) blocks is already legal
+    (full-array coverage, or sublane dim a multiple of 8)."""
+    if hb == h or hb % 8 == 0:
+        return 0
+    return -(-hb // 8) * 8  # round up to the f32 sublane tile
 
 
 def flash4_supported(n: int, h: int, dh: int, itemsize: int) -> bool:
@@ -303,20 +323,28 @@ def _fwd4(q, k, v, scale):
     assert hb is not None, (
         f"flash_attention_4d has no VMEM-fitting head grouping for "
         f"(n={n}, h={h}, dh={dh}) — gate on flash4_supported() first")
+    pad = _lse_pad_rows(hb, h)
     q3, k3, v3 = (x.reshape(b, n, h * dh) for x in (q, k, v))  # free bitcasts
     spec = pl.BlockSpec((1, n, hb * dh), lambda i, j: (i, 0, j))
-    lse_spec = pl.BlockSpec((1, hb, n), lambda i, j: (i, j, 0))
+    if pad:  # grouped-padded lse: (B, H/hb, P, N) with full-tile blocks
+        lse_spec = pl.BlockSpec((1, 1, pad, n), lambda i, j: (i, j, 0, 0))
+        lse_shape = (b, h // hb, pad, n)
+    else:
+        lse_spec = pl.BlockSpec((1, hb, n), lambda i, j: (i, j, 0))
+        lse_shape = (b, h, n)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd4_kernel, heads=hb, scale=scale),
+        functools.partial(_fwd4_kernel, heads=hb, scale=scale, pad_rows=pad),
         grid=(b, h // hb),
         in_specs=[spec, spec, spec],
         out_specs=[spec, lse_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, n, h * dh), q.dtype),
-            jax.ShapeDtypeStruct((b, h, n), jnp.float32),
+            jax.ShapeDtypeStruct(lse_shape, jnp.float32),
         ],
         interpret=_interpret(),
     )(q3, k3, v3)
+    if pad:
+        lse = lse[:, :, :hb, :].reshape(b, h, n)
     return o.reshape(b, n, h, dh), lse
 
 
@@ -337,12 +365,20 @@ def _flash4_bwd(scale, res, cts):
     do, dlse = cts
     b, n, h, dh = q.shape
     hb = _heads_per_program(n, h, dh, q.dtype.itemsize)
+    pad = _lse_pad_rows(hb, h)
     flat = (x.reshape(b, n, h * dh) for x in (q, k, v, o, do))
     q3, k3, v3, o3, do3 = flat
     spec = pl.BlockSpec((1, n, hb * dh), lambda i, j: (i, 0, j))
-    lse_spec = pl.BlockSpec((1, hb, n), lambda i, j: (i, j, 0))
+    if pad:  # re-pad (B, H, N) to the grouped layout the kernel blocks need
+        def regroup(x):
+            g = x.reshape(b, h // hb, hb, n)
+            return jnp.pad(g, ((0, 0), (0, 0), (0, pad - hb), (0, 0)))
+        lse, dlse = regroup(lse), regroup(dlse)
+        lse_spec = pl.BlockSpec((1, 1, pad, n), lambda i, j: (i, j, 0, 0))
+    else:
+        lse_spec = pl.BlockSpec((1, hb, n), lambda i, j: (i, j, 0))
     dq, dk, dv = pl.pallas_call(
-        functools.partial(_bwd4_kernel, heads=hb, scale=scale),
+        functools.partial(_bwd4_kernel, heads=hb, scale=scale, pad_rows=pad),
         grid=(b, h // hb),
         in_specs=[spec, spec, spec, spec, lse_spec, spec, lse_spec],
         out_specs=[spec, spec, spec],
