@@ -147,6 +147,15 @@ def train_presets(n_dev: int) -> dict:
     }
 
 
+def default_scan_unroll(preset: str) -> int:
+    """Per-preset scan unroll. 1 (plain scan) for every preset until the
+    unroll ladder is measured on hardware: a fully-unrolled l14
+    (--no_scan_blocks) measured +29% on v5e because the scan's per-block
+    dus-stacking caps wgrad fusions, so a partial-unroll sweep is queued —
+    set measured winners here and record them in BASELINE.md."""
+    return 1
+
+
 def default_remat_policy(preset: str) -> str:
     """Per-preset remat default (measured on v5e l14: dots_attn_saveable 192.9
     > dots_saveable 190.2 > none_saveable img/s/chip; the 10B flagship keeps
@@ -275,8 +284,11 @@ def bench_train(args, metric_stub: str) -> None:
         kw["batch_size"] = args.batch_size
     if args.remat_policy is None:
         args.remat_policy = default_remat_policy(args.preset)
+    if not args.scan_unroll:
+        args.scan_unroll = default_scan_unroll(args.preset)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
+                 scan_unroll=args.scan_unroll,
                  use_flash_attention=args.use_flash_attention, **kw).validate()
 
     mesh = build_mesh(cfg)
@@ -331,6 +343,7 @@ def bench_train(args, metric_stub: str) -> None:
             # record every A/B knob so an experiment run can never
             # masquerade as the default-config baseline in the JSON
             "scan_blocks": cfg.scan_blocks,
+            "scan_unroll": cfg.scan_unroll,
             "grad_ckpt": cfg.grad_ckpt,
             "use_flash_attention": cfg.use_flash_attention,
         })
@@ -359,6 +372,9 @@ def main():
     p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
                    help="unroll blocks instead of lax.scan (A/B: the scan's "
                         "dus-stacking constrains wgrad fusion layouts)")
+    p.add_argument("--scan_unroll", type=int, default=0,
+                   help="blocks per scan step (0 = preset default); keeps the "
+                        "stacked param tree, frees cross-block fusion")
     p.add_argument("--no_flash_attention", action="store_false",
                    dest="use_flash_attention")
     p.add_argument("--steps", type=int, default=30)

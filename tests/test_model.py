@@ -79,6 +79,31 @@ def test_scan_and_unrolled_blocks_agree():
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_l), rtol=1e-5, atol=1e-5)
 
 
+def test_scan_unroll_matches_unroll1():
+    """--scan_unroll > 1 (multi-block windows inside lax.scan, the wgrad-
+    fusion lever) must not change values or the stacked param tree; a
+    non-divisor unroll exercises lax.scan's remainder handling."""
+    cfg1 = tiny_cfg(grad_ckpt=True, num_blocks=5)
+    model1, params = init_params(cfg1)
+    x = jax.random.normal(jax.random.key(3), (2, 32, 32, 3), jnp.float32)
+
+    def loss(model):
+        return lambda p: jnp.sum(model.apply(p, x, True) ** 2)
+
+    l1, g1 = jax.value_and_grad(loss(model1))(params)
+    for unroll in (3, 64):  # non-divisor of num_blocks; > num_blocks clamps
+        cfgu = tiny_cfg(grad_ckpt=True, num_blocks=5, scan_unroll=unroll)
+        modelu = build_model(cfgu)
+        assert jax.tree.structure(
+            modelu.init(jax.random.key(0), x[:1], True)) == jax.tree.structure(
+            params), "scan_unroll must keep the stacked param tree"
+        lu, gu = jax.value_and_grad(loss(modelu))(params)
+        np.testing.assert_allclose(float(l1), float(lu), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(gu)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
 def test_remat_matches_no_remat():
     """Activation checkpointing must not change forward or gradient values."""
     cfg_a = tiny_cfg(grad_ckpt=True)

@@ -245,6 +245,7 @@ class VisionTransformer(nn.Module):
     num_classes: int = 1000
     dtype: Dtype = jnp.bfloat16
     scan_blocks: bool = True
+    scan_unroll: int = 1
     grad_ckpt: bool = True
     remat_policy: str = "none_saveable"
     attention_impl: Optional[Callable] = None
@@ -290,6 +291,11 @@ class VisionTransformer(nn.Module):
         if self.scan_blocks:
             # One compiled block body via lax.scan; params stacked with a leading
             # (num_blocks,) axis — uniform FSDP sharding and O(1) compile in depth.
+            # unroll > 1 runs that many blocks per scan step: the per-block
+            # dynamic-update-slice stacking constrains wgrad fusion layouts
+            # (profiled 85-100 TF/s vs 164+ unconstrained on v5e), so giving
+            # XLA a multi-block window recovers most of the fully-unrolled
+            # throughput while keeping the stacked tree and O(L/unroll) compile.
             scan = nn.scan(
                 body,
                 variable_axes={"params": 0},
@@ -297,6 +303,7 @@ class VisionTransformer(nn.Module):
                 length=self.num_blocks,
                 in_axes=(nn.broadcast,),
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
+                unroll=min(self.scan_unroll, self.num_blocks),
             )
             x, _ = scan(Block(name="blocks", **block_kwargs), x, deterministic)
         else:
@@ -334,6 +341,7 @@ def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
         num_classes=cfg.num_classes,
         dtype=jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32,
         scan_blocks=cfg.scan_blocks,
+        scan_unroll=cfg.scan_unroll,
         grad_ckpt=cfg.grad_ckpt,
         remat_policy=cfg.remat_policy,
         attention_impl=attention_impl,
