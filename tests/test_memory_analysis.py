@@ -218,17 +218,23 @@ def test_scope_check_fails_when_metadata_stripped(l14):
 
 
 @pytest.mark.slow
-def test_10b_shape_traces_and_lowers(devices8):
+@pytest.mark.parametrize("scan_unroll", [1, 4])
+def test_10b_shape_traces_and_lowers(devices8, scan_unroll):
     """BASELINE config 4 (the 10.078B flagship): eval_shape the sharded state,
     AOT-lower AND compile the full train step on the 8-mesh — no array is ever
     materialized — then assert the ZeRO-3 memory bet AT FLAGSHIP SHAPE from
     the compiled memory analysis: per-device arguments are exactly the
     1/8 state shard (15.12 GB of the 120.94 GB global f32 state) and temps
     stay far below the full 40.3 GB parameter tensor (no hoisted whole-model
-    gather)."""
+    gather).
+
+    Parametrized over --scan_unroll because a K-block scan window all-gathers
+    K blocks' params at once (K x 314.6M x 4 B here) — the wgrad-fusion
+    throughput lever must not silently regress the flagship memory story,
+    including the structural per-block-gather-inside-the-loop property."""
     cfg = Config(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
                  num_blocks=32, num_classes=1000, batch_size=8,
-                 warmup_steps=0).validate()
+                 warmup_steps=0, scan_unroll=scan_unroll).validate()
     state, lowered = _lower_train_step(cfg)
     from vitax.models.vit import expected_param_count
     n = sum(x.size for x in jax.tree.leaves(state.params))
