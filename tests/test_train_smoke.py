@@ -160,6 +160,36 @@ def test_full_loop_fake_data(devices8, tmp_path):
     assert os.path.isdir(os.path.join(str(tmp_path / "ckpt"), "epoch_1"))
 
 
+def test_compile_cache_dir_populates(devices8, tmp_path):
+    """--compile_cache_dir persists compiled step programs so restarts
+    (launcher --restart, preemption resume) skip recompilation. train()
+    mutates global jax.config, so save/restore it here (an empty flag means
+    'no opinion' — later trains in this process would otherwise inherit the
+    dir); threshold 0 makes persistence deterministic for the fast-compiling
+    tiny program."""
+    import os
+
+    from vitax.train.loop import train
+    cache = tmp_path / "xla_cache"
+    cfg = tiny_cfg(
+        fake_data=True, num_epochs=1, steps_per_epoch=2, log_step_interval=1,
+        ckpt_dir=str(tmp_path / "ckpt"), ckpt_epoch_interval=1,
+        test_epoch_interval=10, num_workers=1, batch_size=16,
+        compile_cache_dir=str(cache),
+    )
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_thresh = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        train(cfg)
+        entries = os.listdir(cache)
+        assert entries, "compile cache dir was never populated"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_thresh)
+
+
 def test_sigterm_preemption_save(devices8, tmp_path):
     """SIGTERM mid-training -> committed checkpoint + clean exit + auto-resume
     (the preemption story the async checkpointer enables; vitax/train/preempt.py)."""

@@ -84,6 +84,7 @@ class Config:
     # dots_attn_saveable where activations fit.
     remat_policy: str = "none_saveable" # none_saveable | dots_saveable | dots_attn_saveable (only if grad_ckpt)
     profile_dir: str = ""               # if set, capture a jax.profiler trace of a few steps
+    compile_cache_dir: str = ""         # persistent XLA compile cache (restarts skip recompiles)
     debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
     log_memory: bool = True             # include HBM stats in step log
     steps_per_epoch: int = 0            # override (0 = derive from dataset length // batch_size)
@@ -164,6 +165,7 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--remat_policy", type=str, default=Config.remat_policy,
                      choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
     ext.add_argument("--profile_dir", type=str, default="")
+    ext.add_argument("--compile_cache_dir", type=str, default="")
     ext.add_argument("--debug_nans", action="store_true", dest="debug_nans")
     ext.add_argument("--no_log_memory", action="store_false", dest="log_memory")
     ext.add_argument("--steps_per_epoch", type=int, default=0)
