@@ -174,14 +174,8 @@ def _flash_bh(q, k, v, scale):
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     """Fused attention core; (B, N, H, Dh) -> (B, N, H, Dh), differentiable."""
-    b, n, h, dh = q.shape
-    scale = dh ** -0.5
-
-    def to_bh(x):  # (B, N, H, Dh) -> (B*H, N, Dh)
-        return x.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
-
-    o = _flash_bh(to_bh(q), to_bh(k), to_bh(v), scale)
-    return o.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
+    scale = q.shape[-1] ** -0.5
+    return _from_bh(_flash_bh(_to_bh(q), _to_bh(k), _to_bh(v), scale), q.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -396,6 +390,55 @@ def flash_attention_4d(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return flash4_with_lse(q, k, v, q.shape[-1] ** -0.5)[0]
 
 
+def _select_path(n: int, h: int, dh: int, itemsize: int) -> str:
+    """THE kernel-selection policy, shared by full-sequence dispatch
+    (_tpu_kernel) and ring attention's local block products
+    (block_kernel_with_lse): streaming past the VMEM sequence ceiling, 4D
+    whole-N when a legal head grouping fits the budget, BH relayout
+    otherwise (its whole-array blocks are always legal)."""
+    if n > MAX_SEQ_IN_VMEM:
+        return "streaming"
+    if flash4_supported(n, h, dh, itemsize):
+        return "4d"
+    return "bh"
+
+
+def block_kernel_with_lse(n: int, h: int, dh: int, itemsize: int):
+    """Kernel for one (B, n, h, dh) attention block returning (o, lse (B,h,n)),
+    differentiable in both outputs (the lse cotangent feeds the backward) —
+    the with-lse variants of _select_path's cascade, used by ring attention.
+    o comes back in the input dtype on every path — callers wanting f32
+    accumulation (the logsumexp merge) must cast."""
+    path = _select_path(n, h, dh, itemsize)
+    if path == "4d":
+        return flash4_with_lse
+    if path == "streaming":
+        from vitax.ops.flash_blocked import (
+            DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, blocked_bh_with_lse)
+
+        def streaming(q, k, v, scale):
+            o, lse = blocked_bh_with_lse(
+                _to_bh(q), _to_bh(k), _to_bh(v), scale,
+                DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+            return _from_bh(o, q.shape), lse.reshape(q.shape[0], h, n)
+        return streaming
+
+    def bh(q, k, v, scale):
+        o, lse = flash_bh_with_lse(_to_bh(q), _to_bh(k), _to_bh(v), scale)
+        return _from_bh(o, q.shape), lse.reshape(q.shape[0], h, n)
+    return bh
+
+
+def _to_bh(x):  # (B, N, H, Dh) -> (B*H, N, Dh)
+    b, n, h, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
+
+
+def _from_bh(x, shape):  # (B*H, N, Dh) -> (B, N, H, Dh)
+    b, n, h, dh = shape
+    return x.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
+
+
 def _named(fn, name: str):
     """Tag an attention impl with a human-readable name for the startup log
     (shard_map outputs don't take attribute assignment, so wrap)."""
@@ -420,14 +463,15 @@ def _tpu_kernel(cfg, n: int, force: bool = False, local_heads: int = 0):
         return None, None
     if not force and jax.devices()[0].platform != "tpu":
         return None, None
-    if n > MAX_SEQ_IN_VMEM:
-        # streaming kernel: VMEM use independent of N (vitax/ops/flash_blocked.py)
-        from vitax.ops.flash_blocked import blocked_flash_attention
-        return blocked_flash_attention, "pallas streaming (blocked)"
     h = local_heads or cfg.num_heads
     dh = cfg.embed_dim // cfg.num_heads
     itemsize = 2 if cfg.dtype == "bfloat16" else 4
-    if flash4_supported(n, h, dh, itemsize):
+    path = _select_path(n, h, dh, itemsize)
+    if path == "streaming":
+        # streaming kernel: VMEM use independent of N (vitax/ops/flash_blocked.py)
+        from vitax.ops.flash_blocked import blocked_flash_attention
+        return blocked_flash_attention, "pallas streaming (blocked)"
+    if path == "4d":
         return flash_attention_4d, "pallas fused (4D whole-N)"
     # no legal VMEM-fitting head grouping (large N x D): the BH kernel's
     # per-(b,h) program holds a single (N, N) score temp and still fits

@@ -307,13 +307,11 @@ def blocked_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                             block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
     """Streaming flash attention; (B, N, H, Dh) -> (B, N, H, Dh),
     differentiable, VMEM use independent of N."""
-    b, n, h, dh = q.shape
+    from vitax.ops.attention import _from_bh, _to_bh
+
+    n, dh = q.shape[1], q.shape[3]
     scale = dh ** -0.5
     bq = min(block_q, _pad_len(n, 128))
     bk = min(block_k, _pad_len(n, 128))
-
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
-
-    o = _blocked_bh(to_bh(q), to_bh(k), to_bh(v), scale, bq, bk)
-    return o.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
+    o = _blocked_bh(_to_bh(q), _to_bh(k), _to_bh(v), scale, bq, bk)
+    return _from_bh(o, q.shape)

@@ -18,11 +18,14 @@ Design (TPU-first):
   scheduled by the compiler.
 - Exactly sp-1 rotations per tensor: the last block computes without a
   permute (there is no next block to fetch).
-- The local block product runs on the Pallas kernels on TPU: the whole-N
-  fused kernel up to MAX_SEQ_IN_VMEM local tokens, the streaming (blocked)
-  kernel beyond it — both return (o, lse) and are differentiable in both, so
-  the merge is plain autodiff (vitax/ops/attention.py, flash_blocked.py).
-  Off-TPU (CPU tests) the dense jnp block product is used.
+- The local block product runs on the Pallas kernels on TPU, selected by the
+  same policy cascade as full-sequence dispatch
+  (vitax/ops/attention.py:_select_path): the 4D whole-N kernel when a legal
+  head grouping fits VMEM (no HBM relayouts — these would otherwise run once
+  per ring step per tensor), the BH whole-N kernel as fallback, the
+  streaming (blocked) kernel past MAX_SEQ_IN_VMEM local tokens. All return
+  (o, lse) differentiable in both, so the merge is plain autodiff. Off-TPU
+  (CPU tests) the dense jnp block product is used.
 """
 
 from __future__ import annotations
@@ -49,24 +52,18 @@ def _dense_block(q, k, v, scale: float):
 
 
 def _kernel_block(q, k, v, scale: float):
-    """Pallas block product: whole-N fused kernel when the local block fits
-    VMEM, streaming (blocked) kernel beyond — same (o, lse) contract."""
-    from vitax.ops.attention import MAX_SEQ_IN_VMEM, flash_bh_with_lse
+    """Pallas block product via the shared with-lse kernel selector
+    (vitax/ops/attention.py:block_kernel_with_lse — ONE policy site): 4D
+    whole-N kernel when the local shape has a legal head grouping (no HBM
+    relayouts, which would otherwise run once per ring step per tensor), BH
+    whole-N fallback, streaming kernel past MAX_SEQ_IN_VMEM. All are
+    differentiable in both outputs (the merge is plain autodiff)."""
+    from vitax.ops.attention import block_kernel_with_lse
 
     b, nq, h, dh = q.shape
-
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, -1, dh)
-
-    if nq <= MAX_SEQ_IN_VMEM:
-        o, lse = flash_bh_with_lse(to_bh(q), to_bh(k), to_bh(v), scale)
-    else:
-        from vitax.ops.flash_blocked import (
-            DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, blocked_bh_with_lse)
-        o, lse = blocked_bh_with_lse(to_bh(q), to_bh(k), to_bh(v), scale,
-                                     DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
-    o = o.reshape(b, h, nq, dh).transpose(0, 2, 1, 3).astype(jnp.float32)
-    return o, lse.reshape(b, h, nq)
+    kern = block_kernel_with_lse(nq, h, dh, q.dtype.itemsize)
+    o, lse = kern(q, k, v, scale)
+    return o.astype(jnp.float32), lse
 
 
 def _merge(o, lse, o_blk, lse_blk):
