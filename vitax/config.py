@@ -71,6 +71,8 @@ class Config:
     tp_size: int = 1
     sp_size: int = 1
     sp_impl: str = "ring"               # ring (ppermute K/V rotation) | ulysses (all-to-all head<->token)
+    pp_size: int = 1                    # pipeline stages (GPipe over the stacked layer axis; composes with dp)
+    pp_microbatches: int = 0            # GPipe microbatches per step (0 = pp_size; bubble = (S-1)/(M+S-1))
     scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
     scan_unroll: int = 1                # blocks per scan step: >1 frees XLA to fuse across blocks
     #   (the scan's per-block dus-stacking constrains wgrad fusion layouts —
@@ -108,6 +110,14 @@ class Config:
             f"unknown sp_impl {self.sp_impl!r} (expected 'ring' or 'ulysses')")
         assert self.scan_unroll >= 1, (
             f"--scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.pp_size > 1:
+            assert self.scan_blocks, "--pp_size needs the stacked block tree (drop --no_scan_blocks)"
+            assert self.num_blocks % self.pp_size == 0, (
+                f"--num_blocks {self.num_blocks} not divisible by --pp_size {self.pp_size}")
+            assert max(self.pos_dropout, self.att_dropout, self.mlp_dropout) == 0.0, (
+                "--pp_size > 1 does not thread dropout rngs through the "
+                "pipeline (v1); set dropouts to 0 (the reference defaults)")
+            assert self.pp_microbatches >= 0
         return self
 
 
@@ -159,6 +169,8 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--sp_size", type=int, default=1)
     ext.add_argument("--sp_impl", type=str, default="ring",
                      choices=["ring", "ulysses"])
+    ext.add_argument("--pp_size", type=int, default=1)
+    ext.add_argument("--pp_microbatches", type=int, default=0)
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
     ext.add_argument("--scan_unroll", type=int, default=1)
     ext.add_argument("--host_normalize", action="store_false", dest="device_normalize")

@@ -253,6 +253,20 @@ class VisionTransformer(nn.Module):
     # and shards the token axis over "sp" for sequence parallelism
     token_sharding: Optional[Any] = None
 
+    def block_kwargs(self) -> dict:
+        """Constructor kwargs for one transformer Block — shared between the
+        scan/loop paths below and the pipeline-parallel stage function
+        (vitax/parallel/pipeline.py), which applies detached Blocks against
+        slices of the same stacked param tree."""
+        return dict(
+            num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio,
+            att_dropout=self.att_dropout,
+            mlp_dropout=self.mlp_dropout,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+        )
+
     @nn.compact
     def __call__(self, images: Array, deterministic: bool = True) -> Array:
         """images: (B, H, W, 3) float -> logits (B, num_classes) float32."""
@@ -270,14 +284,7 @@ class VisionTransformer(nn.Module):
         if self.token_sharding is not None:
             x = jax.lax.with_sharding_constraint(x, self.token_sharding)
 
-        block_kwargs = dict(
-            num_heads=self.num_heads,
-            mlp_ratio=self.mlp_ratio,
-            att_dropout=self.att_dropout,
-            mlp_dropout=self.mlp_dropout,
-            dtype=self.dtype,
-            attention_impl=self.attention_impl,
-        )
+        block_kwargs = self.block_kwargs()
 
         def body(block: Block, carry: Array, det: bool):
             return block(carry, det), None

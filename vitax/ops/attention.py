@@ -548,8 +548,14 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
     if mesh is None or mesh.size == 1:
         return _named(kernel, name)
     spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
-    return _named(jax.shard_map(
+    wrapped = _named(jax.shard_map(
         kernel, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     ), name + " + shard_map")
+    # expose the unwrapped kernel for callers that run attention inside
+    # their OWN shard_map (the pp pipeline body) — nesting shard_map over
+    # the same mesh is rejected by JAX, and inside the body the operands
+    # are already local
+    wrapped.vitax_local_impl = _named(kernel, name)
+    return wrapped

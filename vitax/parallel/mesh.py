@@ -4,13 +4,16 @@ TPU-native replacement for the reference's process-per-core world
 (xmp.spawn, reference run_vit_training.py:364): one process per host, all
 devices arranged in a 4-axis `jax.sharding.Mesh`:
 
-  axes = ("dp", "fsdp", "tp", "sp")
+  axes = ("dp", "fsdp", "tp", "sp", "pp")
 
 - "dp":   pure data parallelism (params replicated across it)
 - "fsdp": ZeRO-3 axis — params/grads/optimizer state sharded across it, and it
           also carries batch parallelism (the reference's single 'data' axis)
 - "tp":   tensor parallelism (attention heads / MLP hidden sharded)
 - "sp":   sequence/context parallelism (ring attention over the token axis)
+- "pp":   pipeline parallelism (GPipe stages over the stacked layer axis —
+          vitax/parallel/pipeline.py; composes with dp, v1 excludes
+          fsdp/tp/sp)
 
 The reference's FSDP corresponds to mesh shape (1, n_devices, 1, 1); its
 --run_without_fsdp DP baseline to (n_devices, 1, 1, 1). GSPMD emits the
@@ -28,24 +31,38 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.config import Config
 
-MESH_AXES = ("dp", "fsdp", "tp", "sp")
+MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp")
 
 
-def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[int, int, int, int]:
-    """Resolve (dp, fsdp, tp, sp) against the device count. One axis may be -1
-    (= all remaining devices). `--run_without_fsdp` forces everything onto dp
-    (the reference's pure-DP baseline, run_vit_training.py:171-172)."""
+def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[int, int, int, int, int]:
+    """Resolve (dp, fsdp, tp, sp, pp) against the device count. One axis may be
+    -1 (= all remaining devices). `--run_without_fsdp` forces everything onto dp
+    (the reference's pure-DP baseline, run_vit_training.py:171-172). Pipeline
+    parallelism (pp > 1) composes with dp only in v1: remaining devices default
+    to dp, and fsdp/tp/sp must stay 1 (stage params are held whole per device —
+    the GPipe memory model; see vitax/parallel/pipeline.py)."""
     n = n_devices if n_devices is not None else jax.device_count()
     dp, fsdp, tp, sp = cfg.dp_size, cfg.fsdp_size, cfg.tp_size, cfg.sp_size
+    pp = getattr(cfg, "pp_size", 1)
 
     if cfg.run_without_fsdp:
         if fsdp not in (-1, 1):
             raise ValueError("--run_without_fsdp is incompatible with --fsdp_size > 1")
         fsdp = 1
-        if dp == 1 and tp == 1 and sp == 1:
+        if dp == 1 and tp == 1 and sp == 1 and pp == 1:
             dp = -1  # default DP baseline: all devices data-parallel
 
-    sizes = [dp, fsdp, tp, sp]
+    if pp > 1:
+        if tp != 1 or sp != 1 or fsdp not in (-1, 1):
+            raise ValueError(
+                f"--pp_size {pp} composes with dp only (v1): set "
+                f"--fsdp_size 1, got fsdp={fsdp} tp={tp} sp={sp}")
+        fsdp = 1
+        if dp == 1:
+            dp = -1  # remaining devices carry the batch (whether fsdp was
+            # left at its -1 default or set to 1 explicitly)
+
+    sizes = [dp, fsdp, tp, sp, pp]
     n_auto = sum(1 for s in sizes if s == -1)
     if n_auto > 1:
         raise ValueError(f"at most one mesh axis may be -1, got {sizes}")

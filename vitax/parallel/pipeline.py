@@ -1,0 +1,147 @@
+"""Pipeline parallelism: GPipe stages over the "pp" mesh axis.
+
+Capability beyond the reference (SURVEY.md section 2.3 lists PP as absent).
+TPU-first formulation: the model's blocks are ALREADY a stacked (L, ...)
+parameter tree (the lax.scan layout) — pipeline parallelism is nothing more
+than sharding that leading layer axis over a mesh axis
+(`PartitionSpec("pp", ...)`, vitax/parallel/sharding.py:param_pspec) and
+running the stage schedule inside `jax.shard_map`:
+
+- Stage s holds layers [s*L/S, (s+1)*L/S) — its shard of the stacked tree.
+- The local batch is split into M microbatches (`--pp_microbatches`,
+  default S). At tick t (t = 0..M+S-2), stage s processes microbatch t-s
+  (bubble ticks compute masked garbage — lockstep SPMD, standard GPipe),
+  then hands its activation to stage s+1 via `jax.lax.ppermute` — one ICI
+  hop, overlapped with the next tick's compute by XLA's scheduler.
+- The last stage's valid outputs are the tick outputs [S-1, S-1+M); a psum
+  over "pp" (one nonzero contributor) replicates them so the head/loss run
+  under plain GSPMD afterwards.
+- Backward is plain autodiff through the scan/ppermute: bubble-tick
+  computations receive zero cotangents (their outputs are masked), so only
+  real microbatches contribute gradients, which land on each stage's own
+  param shard.
+
+v1 composes with dp only (stage params held whole per device — the GPipe
+memory model; fsdp/tp/sp composition is a later round's manual-collective
+exercise). Embed/head run data-parallel outside the pipeline, reusing the
+SAME param tree as the scan path functionally — init and checkpoints are
+identical between pp and non-pp topologies, so Orbax cross-topology restore
+covers pp<->fsdp resizes. Dropout is excluded under pp (config.validate).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from vitax.config import Config
+
+
+def make_pp_forward(cfg: Config, model, mesh: Mesh):
+    """(params, images, deterministic) -> logits, GPipe-pipelined over "pp".
+
+    `model` is the same VisionTransformer the scan path uses — its param tree
+    is reused leaf-for-leaf; this function only changes HOW blocks are
+    applied.
+    """
+    import flax.linen as nn
+
+    from vitax.models.vit import _REMAT_POLICIES, Block, PatchEmbed
+
+    S = mesh.shape["pp"]
+    M = cfg.pp_microbatches or S
+    assert cfg.num_blocks % S == 0, (cfg.num_blocks, S)
+    dp_like = mesh.shape["dp"] * mesh.shape["fsdp"]
+    assert cfg.batch_size % (dp_like * M) == 0, (
+        f"batch {cfg.batch_size} must divide by dp*microbatches "
+        f"({dp_like}*{M})")
+
+    # the model's attention impl may be shard_map-wrapped (multi-device
+    # meshes); inside pipeline_body we are ALREADY inside shard_map and the
+    # operands are local, so unwrap to the raw kernel (same selection,
+    # including the dryrun's interpret-mode forcing)
+    bk = model.block_kwargs()
+    bk["attention_impl"] = getattr(
+        bk["attention_impl"], "vitax_local_impl", bk["attention_impl"])
+    block = Block(**bk)
+
+    def one_block(carry, layer_params):
+        return block.apply({"params": layer_params}, carry, True), None
+
+    if cfg.grad_ckpt:
+        one_block = jax.checkpoint(
+            one_block, policy=_REMAT_POLICIES[cfg.remat_policy],
+            prevent_cse=False)
+
+    def stage_fn(stage_params, x):
+        y, _ = jax.lax.scan(one_block, x, stage_params,
+                            unroll=min(cfg.scan_unroll, cfg.num_blocks // S))
+        return y
+
+    def pipeline_body(stage_params, x):
+        # per-device view: stage_params = this stage's (L/S, ...) tree,
+        # x = this dp-shard's (B_loc, N, D) activations (replicated over pp)
+        s = jax.lax.axis_index("pp")
+        b_loc = x.shape[0]
+        mbs = x.reshape(M, b_loc // M, *x.shape[1:])
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(buf, t):
+            inj = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(s == 0, inj, buf)
+            y = stage_fn(stage_params, x_in)
+            y_out = jnp.where(s == S - 1, y, jnp.zeros_like(y))
+            if S > 1:
+                # the final tick's carry is never read — skip its ICI hop
+                # (cond predicate is uniform across devices, so the
+                # collective stays SPMD-legal; cf. ring attention's
+                # "exactly sp-1 rotations")
+                buf = jax.lax.cond(
+                    t < M + S - 2,
+                    lambda v: jax.lax.ppermute(v, "pp", perm),
+                    lambda v: v, y)
+            else:
+                buf = y
+            return buf, y_out
+
+        _, ys = jax.lax.scan(tick, jnp.zeros_like(mbs[0]),
+                             jnp.arange(M + S - 1))
+        outs = ys[S - 1:S - 1 + M]          # microbatch i at tick S-1+i
+        outs = jax.lax.psum(outs, "pp")     # one nonzero contributor
+        return outs.reshape(b_loc, *x.shape[1:])
+
+    act_spec = P(("dp", "fsdp"), None, None)
+
+    def stacked_specs(tree):
+        return jax.tree.map(
+            lambda leaf: P(*("pp",) + (None,) * (leaf.ndim - 1)), tree)
+
+    dtype = model.dtype
+
+    def forward(params, images, deterministic: bool = True):
+        del deterministic  # pp excludes dropout (config.validate), so the
+        # deterministic and non-deterministic paths coincide
+        p = params["params"]
+        x = PatchEmbed(
+            patch_size=cfg.patch_size, embed_dim=cfg.embed_dim, dtype=dtype,
+        ).apply({"params": p["patch_embed"]}, images.astype(dtype))
+        x = x + p["pos_embed"].astype(dtype)
+
+        stacked = p["blocks"]
+        run = jax.shard_map(
+            pipeline_body, mesh=mesh,
+            in_specs=(stacked_specs(stacked), act_spec), out_specs=act_spec,
+            check_vma=False)
+        x = run(stacked, x)
+
+        x = nn.LayerNorm(
+            epsilon=1e-6, dtype=dtype, param_dtype=jnp.float32,
+        ).apply({"params": p["norm"]}, x)
+        x = jnp.mean(x, axis=1)
+        return nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+        ).apply({"params": p["head"]}, x)
+
+    return forward

@@ -73,23 +73,31 @@ def param_pspec(
     path,
     shape: Tuple[int, ...],
     cfg: Config,
-    mesh_shape: Tuple[int, int, int, int],
+    mesh_shape: Tuple[int, int, int, int, int],
     scanned: bool,
 ) -> P:
     """Assign a PartitionSpec to one parameter.
 
     Strategy: apply the TP rule (if tp > 1), then FSDP-shard the largest
     remaining dim divisible by the fsdp axis size. The leading stacked-layers
-    dim of scanned block params is never sharded (lax.scan slices it per
-    iteration; sharding it would serialize a gather per layer).
+    dim of scanned block params is never sharded over fsdp (lax.scan slices it
+    per iteration; sharding it would serialize a gather per layer) — but under
+    pipeline parallelism it IS the partitioned dim: each "pp" stage holds its
+    own contiguous slice of layers (vitax/parallel/pipeline.py).
     """
-    _, fsdp, tp, _ = mesh_shape
+    _, fsdp, tp, _, pp = mesh_shape
     ndim = len(shape)
     names = _path_names(path)
     spec: list = [None] * ndim
 
     is_scanned_block = scanned and "blocks" in names
     first_shardable = 1 if is_scanned_block else 0
+
+    if pp > 1 and is_scanned_block:
+        assert shape[0] % pp == 0, (
+            f"pp: stacked layer dim {shape[0]} of {names} not divisible by "
+            f"pp={pp}")
+        spec[0] = "pp"
 
     if tp > 1:
         tp_dim = _tp_dim(names, ndim, (ndim - 2, ndim - 1))
@@ -115,7 +123,7 @@ def param_pspec(
 
 def param_specs(abstract_params: PyTree, cfg: Config, mesh: Mesh) -> PyTree:
     """PartitionSpec tree matching an (abstract) parameter tree."""
-    mesh_shape = tuple(mesh.shape[a] for a in ("dp", "fsdp", "tp", "sp"))
+    mesh_shape = tuple(mesh.shape[a] for a in ("dp", "fsdp", "tp", "sp", "pp"))
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: param_pspec(path, leaf.shape, cfg, mesh_shape, cfg.scan_blocks),
         abstract_params,

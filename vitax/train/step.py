@@ -29,6 +29,17 @@ def _needs_dropout(cfg: Config) -> bool:
     return (cfg.pos_dropout > 0) or (cfg.att_dropout > 0) or (cfg.mlp_dropout > 0)
 
 
+def _forward_fn(cfg: Config, model, mesh: Mesh):
+    """The deterministic forward: model.apply, or the GPipe pipeline over the
+    "pp" mesh axis when --pp_size > 1 (vitax/parallel/pipeline.py — same
+    param tree, different block application). Dropout under pp is excluded
+    by config.validate, so the dropout branch never routes around this."""
+    if getattr(cfg, "pp_size", 1) > 1 and mesh.shape.get("pp", 1) > 1:
+        from vitax.parallel.pipeline import make_pp_forward
+        return make_pp_forward(cfg, model, mesh)
+    return lambda params, images, det=True: model.apply(params, images, det)
+
+
 def prepare_images(images: jax.Array) -> jax.Array:
     """Device-side ToTensor+Normalize for uint8 batches (the host pipeline's
     reference transforms, run_vit_training.py:44-45/:53-54, moved inside the
@@ -61,13 +72,14 @@ def make_train_step(
     batch_sharding = NamedSharding(mesh, batch_pspec())
     rng_sharding = NamedSharding(mesh, P())
     dropout = _needs_dropout(cfg)
+    forward = _forward_fn(cfg, model, mesh)
 
     def loss_fn(params, batch, rng):
         images = prepare_images(batch["image"])
         if dropout:
             logits = model.apply(params, images, False, rngs={"dropout": rng})
         else:
-            logits = model.apply(params, images, True)
+            logits = forward(params, images, True)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["label"]).mean()
         return loss
@@ -111,9 +123,10 @@ def make_eval_step(cfg: Config, model, mesh: Mesh, state_specs: PyTree):
     run_vit_training.py:306-318, as one compiled reduction)."""
     state_shardings = shardings_of(mesh, state_specs)
     batch_sharding = NamedSharding(mesh, batch_pspec())
+    forward = _forward_fn(cfg, model, mesh)
 
     def eval_step(state: TrainState, batch):
-        logits = model.apply(state.params, prepare_images(batch["image"]), True)
+        logits = forward(state.params, prepare_images(batch["image"]), True)
         pred = jnp.argmax(logits, axis=-1)
         return jnp.sum((pred == batch["label"]).astype(jnp.int32))
 
