@@ -291,7 +291,7 @@ def test_60b_shape_readiness(devices8):
     assert ma.argument_size_in_bytes < (global_bytes / 8 + batch_bytes) * 1.05
 
     # --- virtual v5p-256: specs computed analytically, no 256 devices needed
-    VIRT = (1, 256, 1, 1, 1)  # (dp, fsdp, tp, sp, pp)
+    VIRT = (1, 256, 1, 1, 1, 1)  # (dp, fsdp, tp, sp, pp, ep)
     flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
     pspecs = {}
     for path, leaf in flat:

@@ -28,19 +28,19 @@ def tiny_cfg(**kw):
 
 class TestMeshResolution:
     def test_default_fsdp_all_devices(self):
-        assert resolve_mesh_shape(tiny_cfg(), 8) == (1, 8, 1, 1, 1)
+        assert resolve_mesh_shape(tiny_cfg(), 8) == (1, 8, 1, 1, 1, 1)
 
     def test_run_without_fsdp_is_pure_dp(self):
-        assert resolve_mesh_shape(tiny_cfg(run_without_fsdp=True), 8) == (8, 1, 1, 1, 1)
+        assert resolve_mesh_shape(tiny_cfg(run_without_fsdp=True), 8) == (8, 1, 1, 1, 1, 1)
 
     def test_mixed_axes(self):
-        assert resolve_mesh_shape(tiny_cfg(tp_size=2, fsdp_size=-1), 8) == (1, 4, 2, 1, 1)
-        assert resolve_mesh_shape(tiny_cfg(dp_size=2, fsdp_size=2, tp_size=2), 8) == (2, 2, 2, 1, 1)
+        assert resolve_mesh_shape(tiny_cfg(tp_size=2, fsdp_size=-1), 8) == (1, 4, 2, 1, 1, 1)
+        assert resolve_mesh_shape(tiny_cfg(dp_size=2, fsdp_size=2, tp_size=2), 8) == (2, 2, 2, 1, 1, 1)
 
     def test_pp_defaults_remaining_to_dp(self):
         # pp composes with dp in v1: fsdp auto-resolves to 1, remainder to dp
-        assert resolve_mesh_shape(tiny_cfg(pp_size=2), 8) == (4, 1, 1, 1, 2)
-        assert resolve_mesh_shape(tiny_cfg(pp_size=2, dp_size=4), 8) == (4, 1, 1, 1, 2)
+        assert resolve_mesh_shape(tiny_cfg(pp_size=2), 8) == (4, 1, 1, 1, 2, 1)
+        assert resolve_mesh_shape(tiny_cfg(pp_size=2, dp_size=4), 8) == (4, 1, 1, 1, 2, 1)
 
     def test_bad_shapes_raise(self):
         with pytest.raises(ValueError):

@@ -73,6 +73,10 @@ class Config:
     sp_impl: str = "ring"               # ring (ppermute K/V rotation) | ulysses (all-to-all head<->token)
     pp_size: int = 1                    # pipeline stages (GPipe over the stacked layer axis; composes with dp)
     pp_microbatches: int = 0            # GPipe microbatches per step (0 = pp_size; bubble = (S-1)/(M+S-1))
+    ep_size: int = 1                    # expert-parallel axis (also carries batch; experts sharded across it)
+    moe_experts: int = 0                # 0 = dense reference MLP; >0 = top-1 MoE in every block
+    moe_capacity_factor: float = 1.25   # static expert capacity C = ceil(cf * tokens / experts)
+    moe_aux_weight: float = 0.01        # load-balance aux loss weight (Switch Transformer)
     scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
     scan_unroll: int = 1                # blocks per scan step: >1 frees XLA to fuse across blocks
     #   (the scan's per-block dus-stacking constrains wgrad fusion layouts —
@@ -118,6 +122,15 @@ class Config:
                 "--pp_size > 1 does not thread dropout rngs through the "
                 "pipeline (v1); set dropouts to 0 (the reference defaults)")
             assert self.pp_microbatches >= 0
+        if self.ep_size > 1:
+            assert self.moe_experts > 0, "--ep_size > 1 needs --moe_experts"
+            assert self.moe_experts % self.ep_size == 0, (
+                f"--moe_experts {self.moe_experts} not divisible by "
+                f"--ep_size {self.ep_size}")
+        if self.moe_experts > 0:
+            assert self.pp_size == 1, (
+                "--moe_experts with --pp_size > 1 is not supported (v1): the "
+                "pipeline body does not thread the MoE aux-loss collection")
         return self
 
 
@@ -171,6 +184,10 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["ring", "ulysses"])
     ext.add_argument("--pp_size", type=int, default=1)
     ext.add_argument("--pp_microbatches", type=int, default=0)
+    ext.add_argument("--ep_size", type=int, default=1)
+    ext.add_argument("--moe_experts", type=int, default=0)
+    ext.add_argument("--moe_capacity_factor", type=float, default=1.25)
+    ext.add_argument("--moe_aux_weight", type=float, default=0.01)
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
     ext.add_argument("--scan_unroll", type=int, default=1)
     ext.add_argument("--host_normalize", action="store_false", dest="device_normalize")

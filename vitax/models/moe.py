@@ -1,0 +1,107 @@
+"""Mixture-of-Experts MLP with expert parallelism over the "ep" mesh axis.
+
+Capability beyond the reference (SURVEY.md section 2.3 lists EP as absent —
+the reference's ViT is dense). TPU-first formulation is the GShard/Switch
+einsum form: routing produces a (tokens, experts, capacity) combine tensor,
+dispatch and combine are einsums, and the expert weights carry a leading
+(E, ...) dim sharded over "ep" (vitax/parallel/sharding.py). GSPMD then
+inserts the batch<->expert all-to-alls from the shardings alone — no manual
+collectives, same stance as the FSDP core. The "ep" mesh axis also carries
+batch (vitax/parallel/mesh.py): dense params are replicated over it like dp,
+expert weights stay local to their shard.
+
+Design choices (Switch Transformer, arXiv:2101.03961):
+- top-1 routing with probabilities in float32;
+- static per-group capacity C = ceil(capacity_factor * N / E) (group = one
+  sample's N tokens) — XLA-friendly static shapes; tokens over capacity are
+  dropped (their MoE contribution is zero; the block residual passes them
+  through);
+- auxiliary load-balance loss E * sum_e(frac_tokens_e * mean_prob_e), sown
+  into the "intermediates" collection and added to the CE loss with weight
+  --moe_aux_weight (vitax/train/step.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vitax.models.vit import Array, Dtype, default_init
+
+
+class MoeMlp(nn.Module):
+    """Drop-in replacement for the block Mlp: Dense->GELU->Dense per expert,
+    top-1 routed. (B, N, D) -> (B, N, D)."""
+
+    num_experts: int
+    hidden_dim: int
+    out_dim: int
+    capacity_factor: float = 1.25
+    dtype: Dtype = jnp.bfloat16
+    # NamedSharding for the (E, B, C, D) dispatched tensor: P("ep", batch...)
+    # anchors GSPMD so the dispatch/combine einsums lower to all-to-alls
+    # instead of the partitioner's "involuntary full rematerialization"
+    dispatch_sharding: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        del deterministic  # no dropout inside the MoE MLP (v1)
+        b, n, d = x.shape
+        e = self.num_experts
+        c = max(1, math.ceil(self.capacity_factor * n / e))  # static
+
+        # --- router (float32 end to end: small and stability-critical) ---
+        logits = nn.Dense(
+            e, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=default_init, bias_init=nn.initializers.zeros,
+            name="router",
+        )(x.astype(jnp.float32))                      # (B, N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate = jnp.max(probs, axis=-1)                # (B, N)
+        expert = jnp.argmax(probs, axis=-1)           # (B, N) int
+
+        # --- load-balance aux loss (Switch eq. 4-6) ---
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # (B, N, E)
+        frac_tokens = jnp.mean(onehot, axis=(0, 1))             # (E,)
+        mean_prob = jnp.mean(probs, axis=(0, 1))                # (E,)
+        aux = e * jnp.sum(frac_tokens * mean_prob)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        # --- capacity assignment: slot = rank of the token among those
+        # routed to the same expert, within its (sample) group ---
+        position = jnp.cumsum(onehot, axis=1) * onehot          # (B, N, E)
+        slot = (jnp.sum(position, axis=-1) - 1.0).astype(jnp.int32)  # (B, N)
+        keep = slot < c                                         # (B, N)
+
+        # combine[b, n, e, c] = gate for the token's (expert, slot), 0 if
+        # dropped; dispatch is its boolean support
+        combine = ((gate * keep)[:, :, None, None]              # (B, N, 1, 1)
+                   * onehot[:, :, :, None]                      # (B, N, E, 1)
+                   * jax.nn.one_hot(slot, c,
+                                    dtype=jnp.float32)[:, :, None, :])
+        # -> (B, N, E, C)
+        dispatch = (combine > 0).astype(self.dtype)
+
+        # --- dispatch -> per-expert batches -> combine (GShard einsums) ---
+        xe = jnp.einsum("bnec,bnd->ebcd", dispatch,
+                        x.astype(self.dtype))                   # (E, B, C, D)
+        if self.dispatch_sharding is not None:
+            xe = jax.lax.with_sharding_constraint(xe, self.dispatch_sharding)
+        w1 = self.param("w1", default_init, (e, d, self.hidden_dim), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (e, self.hidden_dim), jnp.float32)
+        w2 = self.param("w2", default_init, (e, self.hidden_dim, self.out_dim), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (e, self.out_dim), jnp.float32)
+        h = jnp.einsum("ebcd,edh->ebch", xe, w1.astype(self.dtype))
+        h = h + b1.astype(self.dtype)[:, None, None, :]
+        h = nn.gelu(h, approximate=False)
+        ye = jnp.einsum("ebch,eho->ebco", h, w2.astype(self.dtype))
+        ye = ye + b2.astype(self.dtype)[:, None, None, :]       # (E, B, C, D)
+        if self.dispatch_sharding is not None:
+            ye = jax.lax.with_sharding_constraint(ye, self.dispatch_sharding)
+
+        return jnp.einsum("bnec,ebcd->bnd", combine.astype(self.dtype), ye)

@@ -167,7 +167,11 @@ class Mlp(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm transformer block (timm Block parity, reference run_vit_training.py:134-141)."""
+    """Pre-norm transformer block (timm Block parity, reference run_vit_training.py:134-141).
+
+    moe_experts > 0 swaps the dense Mlp for the top-1-routed MoE MLP
+    (vitax/models/moe.py) in EVERY block — homogeneous blocks keep the
+    lax.scan stacking (and therefore pp partitioning) intact."""
 
     num_heads: int
     mlp_ratio: float = 4.0
@@ -175,6 +179,9 @@ class Block(nn.Module):
     mlp_dropout: float = 0.0
     dtype: Dtype = jnp.bfloat16
     attention_impl: Optional[Callable] = None
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_dispatch_sharding: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
@@ -192,13 +199,25 @@ class Block(nn.Module):
         )(y, deterministic=deterministic)
         x = x + y
         y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32, name="norm2")(x)
-        y = Mlp(
-            hidden_dim=int(d * self.mlp_ratio),
-            out_dim=d,
-            dropout=self.mlp_dropout,
-            dtype=self.dtype,
-            name="mlp",
-        )(y, deterministic=deterministic)
+        if self.moe_experts > 0:
+            from vitax.models.moe import MoeMlp
+            y = MoeMlp(
+                num_experts=self.moe_experts,
+                hidden_dim=int(d * self.mlp_ratio),
+                out_dim=d,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                dispatch_sharding=self.moe_dispatch_sharding,
+                name="moe",
+            )(y, deterministic=deterministic)
+        else:
+            y = Mlp(
+                hidden_dim=int(d * self.mlp_ratio),
+                out_dim=d,
+                dropout=self.mlp_dropout,
+                dtype=self.dtype,
+                name="mlp",
+            )(y, deterministic=deterministic)
         return x + y
 
 
@@ -249,6 +268,9 @@ class VisionTransformer(nn.Module):
     grad_ckpt: bool = True
     remat_policy: str = "none_saveable"
     attention_impl: Optional[Callable] = None
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_dispatch_sharding: Optional[Any] = None
     # NamedSharding for (B, N, D) activations — anchors GSPMD batch sharding
     # and shards the token axis over "sp" for sequence parallelism
     token_sharding: Optional[Any] = None
@@ -265,6 +287,9 @@ class VisionTransformer(nn.Module):
             mlp_dropout=self.mlp_dropout,
             dtype=self.dtype,
             attention_impl=self.attention_impl,
+            moe_experts=self.moe_experts,
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_dispatch_sharding=self.moe_dispatch_sharding,
         )
 
     @nn.compact
@@ -305,7 +330,9 @@ class VisionTransformer(nn.Module):
             # throughput while keeping the stacked tree and O(L/unroll) compile.
             scan = nn.scan(
                 body,
-                variable_axes={"params": 0},
+                # intermediates: per-layer sown values (the MoE aux loss)
+                # stack along the layer axis like the params
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=self.num_blocks,
                 in_axes=(nn.broadcast,),
@@ -331,7 +358,7 @@ class VisionTransformer(nn.Module):
 
 
 def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
-                token_sharding=None) -> VisionTransformer:
+                token_sharding=None, moe_dispatch_sharding=None) -> VisionTransformer:
     """Construct the model from config (reference build_fsdp_vit_model parity,
     run_vit_training.py:165-200 — minus the wrapping, which in vitax is a sharding
     declaration applied at jit boundaries, not a module transform)."""
@@ -352,6 +379,9 @@ def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
         grad_ckpt=cfg.grad_ckpt,
         remat_policy=cfg.remat_policy,
         attention_impl=attention_impl,
+        moe_experts=cfg.moe_experts,
+        moe_capacity_factor=cfg.moe_capacity_factor,
+        moe_dispatch_sharding=moe_dispatch_sharding,
         token_sharding=token_sharding,
     )
 

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
+from vitax.parallel.mesh import BATCH_AXES
+
 MAX_SEQ_IN_VMEM = 2048  # (N, N) f32 scores: 16 MB at 2048 — VMEM ceiling
 
 
@@ -547,7 +549,7 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
 
     if mesh is None or mesh.size == 1:
         return _named(kernel, name)
-    spec = P(("dp", "fsdp"), None, "tp", None)  # (B, N, H, Dh)
+    spec = P(BATCH_AXES, None, "tp", None)  # (B, N, H, Dh)
     wrapped = _named(jax.shard_map(
         kernel, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,

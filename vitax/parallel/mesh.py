@@ -2,9 +2,9 @@
 
 TPU-native replacement for the reference's process-per-core world
 (xmp.spawn, reference run_vit_training.py:364): one process per host, all
-devices arranged in a 4-axis `jax.sharding.Mesh`:
+devices arranged in a 6-axis `jax.sharding.Mesh`:
 
-  axes = ("dp", "fsdp", "tp", "sp", "pp")
+  axes = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 - "dp":   pure data parallelism (params replicated across it)
 - "fsdp": ZeRO-3 axis — params/grads/optimizer state sharded across it, and it
@@ -14,6 +14,9 @@ devices arranged in a 4-axis `jax.sharding.Mesh`:
 - "pp":   pipeline parallelism (GPipe stages over the stacked layer axis —
           vitax/parallel/pipeline.py; composes with dp, v1 excludes
           fsdp/tp/sp)
+- "ep":   expert parallelism (vitax/models/moe.py) — carries batch like dp,
+          and MoE expert weights shard their leading (E, ...) dim across it;
+          GSPMD inserts the batch<->expert all-to-alls from the specs
 
 The reference's FSDP corresponds to mesh shape (1, n_devices, 1, 1); its
 --run_without_fsdp DP baseline to (n_devices, 1, 1, 1). GSPMD emits the
@@ -31,11 +34,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.config import Config
 
-MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp")
+MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 
-def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[int, int, int, int, int]:
-    """Resolve (dp, fsdp, tp, sp, pp) against the device count. One axis may be
+def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[int, ...]:
+    """Resolve (dp, fsdp, tp, sp, pp, ep) against the device count. One axis may be
     -1 (= all remaining devices). `--run_without_fsdp` forces everything onto dp
     (the reference's pure-DP baseline, run_vit_training.py:171-172). Pipeline
     parallelism (pp > 1) composes with dp only in v1: remaining devices default
@@ -44,12 +47,13 @@ def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[in
     n = n_devices if n_devices is not None else jax.device_count()
     dp, fsdp, tp, sp = cfg.dp_size, cfg.fsdp_size, cfg.tp_size, cfg.sp_size
     pp = getattr(cfg, "pp_size", 1)
+    ep = getattr(cfg, "ep_size", 1)
 
     if cfg.run_without_fsdp:
         if fsdp not in (-1, 1):
             raise ValueError("--run_without_fsdp is incompatible with --fsdp_size > 1")
         fsdp = 1
-        if dp == 1 and tp == 1 and sp == 1 and pp == 1:
+        if dp == 1 and tp == 1 and sp == 1 and pp == 1 and ep == 1:
             dp = -1  # default DP baseline: all devices data-parallel
 
     if pp > 1:
@@ -62,7 +66,7 @@ def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[in
             dp = -1  # remaining devices carry the batch (whether fsdp was
             # left at its -1 default or set to 1 explicitly)
 
-    sizes = [dp, fsdp, tp, sp, pp]
+    sizes = [dp, fsdp, tp, sp, pp, ep]
     n_auto = sum(1 for s in sizes if s == -1)
     if n_auto > 1:
         raise ValueError(f"at most one mesh axis may be -1, got {sizes}")
@@ -77,7 +81,7 @@ def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[in
 
 
 def build_mesh(cfg: Config, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build the 4-axis mesh. Device order follows jax.devices(), which on TPU
+    """Build the 6-axis mesh. Device order follows jax.devices(), which on TPU
     reflects physical torus coordinates — keeping the fastest-varying axis
     ("sp", then "tp") on the closest ICI neighbors."""
     devices = list(devices) if devices is not None else jax.devices()
@@ -86,14 +90,18 @@ def build_mesh(cfg: Config, devices: Optional[Sequence[jax.Device]] = None) -> M
     return Mesh(arr, MESH_AXES)
 
 
+BATCH_AXES = ("dp", "fsdp", "ep")  # mesh axes that carry the global batch
+
+
 def batch_pspec(sp_shard_tokens: bool = False) -> P:
-    """PartitionSpec for a (B, ...) batch: batch over dp+fsdp.
+    """PartitionSpec for a (B, ...) batch: batch over dp+fsdp+ep.
 
     The reference shards the global batch across all ranks
     (DistributedSampler, run_vit_training.py:62-64); here the same statement is
     one PartitionSpec. With sequence parallelism the token axis of activations
     is additionally sharded over "sp" (handled inside the model/step, not on the
-    raw image batch).
+    raw image batch). "ep" carries batch too — expert parallelism is data
+    parallelism whose MoE expert weights are sharded instead of replicated.
     """
     del sp_shard_tokens
-    return P(("dp", "fsdp"))
+    return P(BATCH_AXES)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.config import Config
+from vitax.parallel.mesh import BATCH_AXES
 
 
 def make_pp_forward(cfg: Config, model, mesh: Mesh):
@@ -112,7 +113,7 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh):
         outs = jax.lax.psum(outs, "pp")     # one nonzero contributor
         return outs.reshape(b_loc, *x.shape[1:])
 
-    act_spec = P(("dp", "fsdp"), None, None)
+    act_spec = P(BATCH_AXES, None, None)
 
     def stacked_specs(tree):
         return jax.tree.map(

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from vitax.parallel.mesh import BATCH_AXES
+
 
 def _dense_block(q, k, v, scale: float):
     """Dense jnp block product: q (B, nq, H, Dh) x k/v (B, nk, H, Dh) ->
@@ -108,7 +110,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     if use_kernel is None:
         use_kernel = jax.devices()[0].platform == "tpu"
     block_fn = _kernel_block if use_kernel else _dense_block
-    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    spec = P(BATCH_AXES, axis_name, "tp", None)
 
     def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         scale = q.shape[-1] ** -0.5

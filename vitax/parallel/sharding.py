@@ -73,7 +73,7 @@ def param_pspec(
     path,
     shape: Tuple[int, ...],
     cfg: Config,
-    mesh_shape: Tuple[int, int, int, int, int],
+    mesh_shape: Tuple[int, ...],  # (dp, fsdp, tp, sp, pp, ep)
     scanned: bool,
 ) -> P:
     """Assign a PartitionSpec to one parameter.
@@ -85,7 +85,7 @@ def param_pspec(
     pipeline parallelism it IS the partitioned dim: each "pp" stage holds its
     own contiguous slice of layers (vitax/parallel/pipeline.py).
     """
-    _, fsdp, tp, _, pp = mesh_shape
+    _, fsdp, tp, _, pp, ep = mesh_shape
     ndim = len(shape)
     names = _path_names(path)
     spec: list = [None] * ndim
@@ -98,6 +98,17 @@ def param_pspec(
             f"pp: stacked layer dim {shape[0]} of {names} not divisible by "
             f"pp={pp}")
         spec[0] = "pp"
+
+    if ep > 1 and "moe" in names and names[-1] in ("w1", "b1", "w2", "b2"):
+        # expert weights: the (E, ...) experts dim shards over "ep" (the
+        # GShard layout — vitax/models/moe.py); router params follow the
+        # default rules like any dense weight
+        e_dim = first_shardable
+        assert shape[e_dim] % ep == 0, (
+            f"ep: experts dim {e_dim} of {names} {shape} not divisible by "
+            f"ep={ep}")
+        spec[e_dim] = "ep"
+        first_shardable = e_dim + 1  # fsdp picks among the remaining dims
 
     if tp > 1:
         tp_dim = _tp_dim(names, ndim, (ndim - 2, ndim - 1))
@@ -123,7 +134,7 @@ def param_pspec(
 
 def param_specs(abstract_params: PyTree, cfg: Config, mesh: Mesh) -> PyTree:
     """PartitionSpec tree matching an (abstract) parameter tree."""
-    mesh_shape = tuple(mesh.shape[a] for a in ("dp", "fsdp", "tp", "sp", "pp"))
+    mesh_shape = tuple(mesh.shape[a] for a in ("dp", "fsdp", "tp", "sp", "pp", "ep"))
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: param_pspec(path, leaf.shape, cfg, mesh_shape, cfg.scan_blocks),
         abstract_params,

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.ops.attention import reference_attention
+from vitax.parallel.mesh import BATCH_AXES
 
 
 def _ulysses_local(q, k, v, inner: Callable, axis_name: str):
@@ -57,7 +58,7 @@ def make_ulysses_attention(mesh: Mesh, inner: Optional[Callable] = None,
     num_heads % (sp * tp) == 0 (checked by the caller,
     vitax.ops.attention.make_attention_impl).
     """
-    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    spec = P(BATCH_AXES, axis_name, "tp", None)
     inner = inner if inner is not None else reference_attention
 
     def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:

@@ -22,7 +22,7 @@ from vitax.checkpoint import restore_state, save_state
 from vitax.config import Config
 from vitax.data import build_datasets
 from vitax.models import build_model, count_params
-from vitax.parallel.mesh import build_mesh
+from vitax.parallel.mesh import BATCH_AXES, build_mesh
 from vitax.train.state import TrainState, build_optimizer, make_train_state
 from vitax.train.step import make_eval_step, make_train_step
 from vitax.utils.logging import master_print, memory_summary
@@ -80,7 +80,8 @@ def train(cfg: Config) -> TrainState:
         cfg = dataclasses.replace(cfg, resume_epoch=found)
         master_print(f"auto-resume: {'epoch ' + str(found) if found else 'no checkpoint found, fresh start'}")
     model = build_model(cfg, attention_impl=attention_impl,
-                        token_sharding=_token_sharding(cfg, mesh))
+                        token_sharding=_token_sharding(cfg, mesh),
+                        moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh))
     steps_per_epoch = cfg.steps_per_epoch or (len(train_ds) // cfg.batch_size)
     max_iteration = steps_per_epoch * cfg.num_epochs
     tx, schedule = build_optimizer(cfg, max_iteration)
@@ -228,7 +229,20 @@ def _token_sharding(cfg: Config, mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
     sp = mesh.shape.get("sp", 1)
     token_axis = "sp" if (sp > 1 and cfg.num_patches % sp == 0) else None
-    return NamedSharding(mesh, P(("dp", "fsdp"), token_axis, None))
+    return NamedSharding(mesh, P(BATCH_AXES, token_axis, None))
+
+
+def _moe_dispatch_sharding(cfg: Config, mesh):
+    """(E, B, C, D) dispatched-tensor sharding for the MoE einsums: experts
+    over "ep", batch over the data axes. The explicit anchor makes GSPMD
+    lower dispatch/combine to all-to-alls instead of the partitioner's
+    involuntary full rematerialization. None when dense or single-device."""
+    if cfg.moe_experts == 0 or mesh.size == 1:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ep = mesh.shape.get("ep", 1)
+    return NamedSharding(
+        mesh, P("ep" if ep > 1 else None, ("dp", "fsdp"), None, None))
 
 
 def _select_attention(cfg: Config, mesh):

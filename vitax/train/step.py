@@ -74,14 +74,27 @@ def make_train_step(
     dropout = _needs_dropout(cfg)
     forward = _forward_fn(cfg, model, mesh)
 
+    moe = cfg.moe_experts > 0
+
     def loss_fn(params, batch, rng):
         images = prepare_images(batch["image"])
-        if dropout:
+        if moe:
+            # collect the per-block MoE load-balance losses sown into the
+            # "intermediates" collection (vitax/models/moe.py); mean over
+            # blocks, weighted into the objective (Switch Transformer)
+            rngs = {"dropout": rng} if dropout else None
+            logits, cols = model.apply(params, images, not dropout,
+                                       rngs=rngs, mutable=["intermediates"])
+            aux = sum(jnp.sum(a) for a in jax.tree.leaves(cols))
+            aux = aux / cfg.num_blocks
+        elif dropout:
             logits = model.apply(params, images, False, rngs={"dropout": rng})
         else:
             logits = forward(params, images, True)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["label"]).mean()
+        if moe:
+            loss = loss + cfg.moe_aux_weight * aux
         return loss
 
     zero2 = not cfg.reshard_after_forward and not cfg.run_without_fsdp
