@@ -37,10 +37,13 @@ class TestMeshResolution:
         assert resolve_mesh_shape(tiny_cfg(tp_size=2, fsdp_size=-1), 8) == (1, 4, 2, 1, 1, 1)
         assert resolve_mesh_shape(tiny_cfg(dp_size=2, fsdp_size=2, tp_size=2), 8) == (2, 2, 2, 1, 1, 1)
 
-    def test_pp_defaults_remaining_to_dp(self):
-        # pp composes with dp in v1: fsdp auto-resolves to 1, remainder to dp
-        assert resolve_mesh_shape(tiny_cfg(pp_size=2), 8) == (4, 1, 1, 1, 2, 1)
-        assert resolve_mesh_shape(tiny_cfg(pp_size=2, dp_size=4), 8) == (4, 1, 1, 1, 2, 1)
+    def test_pp_mesh_resolution(self):
+        # default: remaining devices go to fsdp (ZeRO-3 inside the pipeline)
+        assert resolve_mesh_shape(tiny_cfg(pp_size=2), 8) == (1, 4, 1, 1, 2, 1)
+        # pure dp x pp: explicit fsdp=1 defaults the remainder onto dp
+        assert resolve_mesh_shape(tiny_cfg(pp_size=2, fsdp_size=1), 8) == (4, 1, 1, 1, 2, 1)
+        # explicit three-way dp x fsdp x pp
+        assert resolve_mesh_shape(tiny_cfg(pp_size=2, fsdp_size=2, dp_size=2), 8) == (2, 2, 1, 1, 2, 1)
 
     def test_bad_shapes_raise(self):
         with pytest.raises(ValueError):
@@ -49,10 +52,8 @@ class TestMeshResolution:
             resolve_mesh_shape(tiny_cfg(dp_size=-1, fsdp_size=-1), 8)
         with pytest.raises(ValueError):
             resolve_mesh_shape(tiny_cfg(run_without_fsdp=True, fsdp_size=4), 8)
-        with pytest.raises(ValueError):  # pp does not compose with tp/sp/fsdp (v1)
+        with pytest.raises(ValueError):  # pp does not compose with tp/sp (v1)
             resolve_mesh_shape(tiny_cfg(pp_size=2, tp_size=2), 8)
-        with pytest.raises(ValueError):
-            resolve_mesh_shape(tiny_cfg(pp_size=2, fsdp_size=2), 8)
 
 
 class TestParamSpecs:

@@ -71,7 +71,7 @@ class Config:
     tp_size: int = 1
     sp_size: int = 1
     sp_impl: str = "ring"               # ring (ppermute K/V rotation) | ulysses (all-to-all head<->token)
-    pp_size: int = 1                    # pipeline stages (GPipe over the stacked layer axis; composes with dp)
+    pp_size: int = 1                    # pipeline stages (GPipe over the stacked layer axis; composes with dp and fsdp)
     pp_microbatches: int = 0            # GPipe microbatches per step (0 = pp_size; bubble = (S-1)/(M+S-1))
     ep_size: int = 1                    # expert-parallel axis (also carries batch; experts sharded across it)
     moe_experts: int = 0                # 0 = dense reference MLP; >0 = top-1 MoE in every block
@@ -116,6 +116,11 @@ class Config:
             f"--scan_unroll must be >= 1, got {self.scan_unroll}")
         if self.pp_size > 1:
             assert self.scan_blocks, "--pp_size needs the stacked block tree (drop --no_scan_blocks)"
+            assert self.reshard_after_forward, (
+                "--no_reshard_after_forward (ZeRO-2) under --pp_size > 1 is "
+                "not supported: the pipeline body gathers each block's "
+                "shards just-in-time (ZeRO-3 semantics) and a step-top "
+                "full gather would defeat that")
             assert self.num_blocks % self.pp_size == 0, (
                 f"--num_blocks {self.num_blocks} not divisible by --pp_size {self.pp_size}")
             assert max(self.pos_dropout, self.att_dropout, self.mlp_dropout) == 0.0, (

@@ -12,8 +12,8 @@ devices arranged in a 6-axis `jax.sharding.Mesh`:
 - "tp":   tensor parallelism (attention heads / MLP hidden sharded)
 - "sp":   sequence/context parallelism (ring attention over the token axis)
 - "pp":   pipeline parallelism (GPipe stages over the stacked layer axis —
-          vitax/parallel/pipeline.py; composes with dp, v1 excludes
-          fsdp/tp/sp)
+          vitax/parallel/pipeline.py; composes with dp and fsdp/ZeRO-3,
+          v1 excludes tp/sp)
 - "ep":   expert parallelism (vitax/models/moe.py) — carries batch like dp,
           and MoE expert weights shard their leading (E, ...) dim across it;
           GSPMD inserts the batch<->expert all-to-alls from the specs
@@ -41,9 +41,9 @@ def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[in
     """Resolve (dp, fsdp, tp, sp, pp, ep) against the device count. One axis may be
     -1 (= all remaining devices). `--run_without_fsdp` forces everything onto dp
     (the reference's pure-DP baseline, run_vit_training.py:171-172). Pipeline
-    parallelism (pp > 1) composes with dp only in v1: remaining devices default
-    to dp, and fsdp/tp/sp must stay 1 (stage params are held whole per device —
-    the GPipe memory model; see vitax/parallel/pipeline.py)."""
+    parallelism (pp > 1) composes with dp and fsdp (ZeRO-3 gathers run
+    just-in-time inside the pipeline body); tp/sp under pp are excluded in v1
+    (see vitax/parallel/pipeline.py)."""
     n = n_devices if n_devices is not None else jax.device_count()
     dp, fsdp, tp, sp = cfg.dp_size, cfg.fsdp_size, cfg.tp_size, cfg.sp_size
     pp = getattr(cfg, "pp_size", 1)
@@ -57,14 +57,18 @@ def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[in
             dp = -1  # default DP baseline: all devices data-parallel
 
     if pp > 1:
-        if tp != 1 or sp != 1 or fsdp not in (-1, 1):
+        if tp != 1 or sp != 1:
             raise ValueError(
-                f"--pp_size {pp} composes with dp only (v1): set "
-                f"--fsdp_size 1, got fsdp={fsdp} tp={tp} sp={sp}")
-        fsdp = 1
-        if dp == 1:
-            dp = -1  # remaining devices carry the batch (whether fsdp was
-            # left at its -1 default or set to 1 explicitly)
+                f"--pp_size {pp} does not compose with tp/sp (v1): got "
+                f"tp={tp} sp={sp}")
+        # fsdp composes: ZeRO-3 shards are gathered just-in-time inside the
+        # pipeline body (vitax/parallel/pipeline.py). With --fsdp_size 1 the
+        # remaining devices default to carrying the batch on dp; an explicit
+        # --dp_size -1 wins over fsdp's -1 default (round-2 CLI behavior).
+        if fsdp == 1 and dp == 1:
+            dp = -1
+        elif dp == -1 and fsdp == -1:
+            fsdp = 1
 
     sizes = [dp, fsdp, tp, sp, pp, ep]
     n_auto = sum(1 for s in sizes if s == -1)

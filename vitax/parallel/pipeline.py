@@ -21,12 +21,19 @@ running the stage schedule inside `jax.shard_map`:
   real microbatches contribute gradients, which land on each stage's own
   param shard.
 
-v1 composes with dp only (stage params held whole per device — the GPipe
-memory model; fsdp/tp/sp composition is a later round's manual-collective
-exercise). Embed/head run data-parallel outside the pipeline, reusing the
-SAME param tree as the scan path functionally — init and checkpoints are
-identical between pp and non-pp topologies, so Orbax cross-topology restore
-covers pp<->fsdp resizes. Dropout is excluded under pp (config.validate).
+Composes with dp AND fsdp/ZeRO-3 (tp/sp are excluded): block params may
+carry "fsdp" placements on their weight dims in addition to "pp" on the
+layer dim. Inside the pipeline body each block's leaves are all-gathered
+over "fsdp" right before use — the manual form of the per-block gather
+GSPMD emits on the scan path — and autodiff's transpose of that gather is
+a reduce-scatter, so gradients land back on the ZeRO-3 shards. With remat
+the gather sits inside the checkpointed block, so the backward re-gathers
+instead of keeping gathered weights live: full ZeRO-3 memory semantics
+inside GPipe. Embed/head run data-parallel outside the pipeline, reusing
+the SAME param tree as the scan path functionally — init and checkpoints
+are identical between pp and non-pp topologies, so Orbax cross-topology
+restore covers pp<->fsdp resizes. Dropout is excluded under pp
+(config.validate).
 """
 
 from __future__ import annotations
@@ -39,12 +46,23 @@ from vitax.config import Config
 from vitax.parallel.mesh import BATCH_AXES
 
 
-def make_pp_forward(cfg: Config, model, mesh: Mesh):
+def _gather_over(x, spec: P, axis_name: str):
+    """All-gather the dims of `x` that `spec` places on `axis_name` (tiled:
+    the gathered dim returns to its full size in place)."""
+    for dim, ax in enumerate(spec):
+        if ax == axis_name:
+            x = jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+    return x
+
+
+def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     """(params, images, deterministic) -> logits, GPipe-pipelined over "pp".
 
     `model` is the same VisionTransformer the scan path uses — its param tree
     is reused leaf-for-leaf; this function only changes HOW blocks are
-    applied.
+    applied. `block_specs` is the PartitionSpec tree of the stacked block
+    params (P("pp", ...) with optional "fsdp" dims) — when omitted, a
+    pp-only layout is assumed (stage params whole per device).
     """
     import flax.linen as nn
 
@@ -53,9 +71,9 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh):
     S = mesh.shape["pp"]
     M = cfg.pp_microbatches or S
     assert cfg.num_blocks % S == 0, (cfg.num_blocks, S)
-    dp_like = mesh.shape["dp"] * mesh.shape["fsdp"]
+    dp_like = (mesh.shape["dp"] * mesh.shape["fsdp"] * mesh.shape["ep"])
     assert cfg.batch_size % (dp_like * M) == 0, (
-        f"batch {cfg.batch_size} must divide by dp*microbatches "
+        f"batch {cfg.batch_size} must divide by data-axes*microbatches "
         f"({dp_like}*{M})")
 
     # the model's attention impl may be shard_map-wrapped (multi-device
@@ -67,7 +85,23 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh):
         bk["attention_impl"], "vitax_local_impl", bk["attention_impl"])
     block = Block(**bk)
 
+    # per-layer specs: drop the leading (stacked/"pp") dim of each leaf spec
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    layer_specs = (None if block_specs is None else jax.tree.map(
+        lambda s: P(*s[1:]), block_specs, is_leaf=is_spec))
+
     def one_block(carry, layer_params):
+        if layer_specs is not None and mesh.shape["fsdp"] > 1:
+            # ZeRO-3 inside the pipeline: gather this block's shards over
+            # "fsdp" just-in-time (under remat this sits inside the
+            # checkpointed region, so backward re-gathers rather than
+            # holding gathered weights live; the gather's transpose
+            # reduce-scatters the weight cotangents onto the shards).
+            # NOTE specs lead the tree.map: P is a tuple subclass, so it
+            # must be the is_leaf-guarded first tree
+            layer_params = jax.tree.map(
+                lambda s, x: _gather_over(x, s, "fsdp"),
+                layer_specs, layer_params, is_leaf=is_spec)
         return block.apply({"params": layer_params}, carry, True), None
 
     if cfg.grad_ckpt:
@@ -131,9 +165,11 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh):
         x = x + p["pos_embed"].astype(dtype)
 
         stacked = p["blocks"]
+        in_specs = (block_specs if block_specs is not None
+                    else stacked_specs(stacked))
         run = jax.shard_map(
             pipeline_body, mesh=mesh,
-            in_specs=(stacked_specs(stacked), act_spec), out_specs=act_spec,
+            in_specs=(in_specs, act_spec), out_specs=act_spec,
             check_vma=False)
         x = run(stacked, x)
 

@@ -29,14 +29,20 @@ def _needs_dropout(cfg: Config) -> bool:
     return (cfg.pos_dropout > 0) or (cfg.att_dropout > 0) or (cfg.mlp_dropout > 0)
 
 
-def _forward_fn(cfg: Config, model, mesh: Mesh):
+def _forward_fn(cfg: Config, model, mesh: Mesh, state_specs=None):
     """The deterministic forward: model.apply, or the GPipe pipeline over the
     "pp" mesh axis when --pp_size > 1 (vitax/parallel/pipeline.py — same
     param tree, different block application). Dropout under pp is excluded
-    by config.validate, so the dropout branch never routes around this."""
+    by config.validate, so the dropout branch never routes around this.
+    The block-param specs (P("pp", ...) + optional "fsdp" dims) come from
+    the state spec tree so the pipeline's just-in-time ZeRO-3 gathers match
+    the actual layout."""
     if getattr(cfg, "pp_size", 1) > 1 and mesh.shape.get("pp", 1) > 1:
         from vitax.parallel.pipeline import make_pp_forward
-        return make_pp_forward(cfg, model, mesh)
+        block_specs = None
+        if state_specs is not None:
+            block_specs = state_specs.params["params"]["blocks"]
+        return make_pp_forward(cfg, model, mesh, block_specs=block_specs)
     return lambda params, images, det=True: model.apply(params, images, det)
 
 
@@ -72,7 +78,7 @@ def make_train_step(
     batch_sharding = NamedSharding(mesh, batch_pspec())
     rng_sharding = NamedSharding(mesh, P())
     dropout = _needs_dropout(cfg)
-    forward = _forward_fn(cfg, model, mesh)
+    forward = _forward_fn(cfg, model, mesh, state_specs)
 
     moe = cfg.moe_experts > 0
 
@@ -136,7 +142,7 @@ def make_eval_step(cfg: Config, model, mesh: Mesh, state_specs: PyTree):
     run_vit_training.py:306-318, as one compiled reduction)."""
     state_shardings = shardings_of(mesh, state_specs)
     batch_sharding = NamedSharding(mesh, batch_pspec())
-    forward = _forward_fn(cfg, model, mesh)
+    forward = _forward_fn(cfg, model, mesh, state_specs)
 
     def eval_step(state: TrainState, batch):
         logits = forward(state.params, prepare_images(batch["image"]), True)
