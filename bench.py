@@ -131,6 +131,13 @@ def train_presets(n_dev: int) -> dict:
         # BASELINE.json config 2 shape (ViT-B/16, pure-DP benchmark)
         "b16": dict(image_size=224, patch_size=16, embed_dim=768, num_heads=12,
                     num_blocks=12, batch_size=64 * n_dev),
+        # ViT-B/16 with a top-1 Switch MoE MLP (8 experts) in every block:
+        # measures the routing/dispatch overhead vs the dense b16 row (per-
+        # token useful FLOPs are identical under top-1 routing, so the MFU
+        # accounting below stays valid; router FLOPs are negligible)
+        "b16_moe": dict(image_size=224, patch_size=16, embed_dim=768,
+                        num_heads=12, num_blocks=12, batch_size=64 * n_dev,
+                        moe_experts=8),
         "l14": dict(image_size=224, patch_size=14, embed_dim=1024, num_heads=16,
                     num_blocks=24, batch_size=32 * n_dev),
         "10b": dict(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
@@ -361,7 +368,7 @@ def bench_train(args, metric_stub: str) -> None:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="l14",
-                   choices=["tiny", "b16", "l14", "10b", "10b_slice", "data"])
+                   choices=["tiny", "b16", "b16_moe", "l14", "10b", "10b_slice", "data"])
     p.add_argument("--batch_size", type=int, default=0)
     # default resolved per preset in bench_train: dots_saveable measured fastest
     # on v5e where activations fit; the 10B flagship keeps none_saveable

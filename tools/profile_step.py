@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="l14",
-                   choices=["tiny", "b16", "l14", "10b", "10b_slice"])
+                   choices=["tiny", "b16", "b16_moe", "l14", "10b", "10b_slice"])
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--batch_size", type=int, default=0)

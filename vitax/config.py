@@ -116,11 +116,12 @@ class Config:
             f"--scan_unroll must be >= 1, got {self.scan_unroll}")
         if self.pp_size > 1:
             assert self.scan_blocks, "--pp_size needs the stacked block tree (drop --no_scan_blocks)"
-            assert self.reshard_after_forward, (
-                "--no_reshard_after_forward (ZeRO-2) under --pp_size > 1 is "
-                "not supported: the pipeline body gathers each block's "
-                "shards just-in-time (ZeRO-3 semantics) and a step-top "
-                "full gather would defeat that")
+            assert self.reshard_after_forward or self.fsdp_size == 1, (
+                "--no_reshard_after_forward (ZeRO-2) under --pp_size > 1 "
+                "with fsdp sharding is not supported: the pipeline body "
+                "gathers each block's shards just-in-time (ZeRO-3 "
+                "semantics) and a step-top full gather would defeat that "
+                "(with --fsdp_size 1 the flag is a no-op and allowed)")
             assert self.num_blocks % self.pp_size == 0, (
                 f"--num_blocks {self.num_blocks} not divisible by --pp_size {self.pp_size}")
             assert max(self.pos_dropout, self.att_dropout, self.mlp_dropout) == 0.0, (
