@@ -154,12 +154,21 @@ def train_presets(n_dev: int) -> dict:
     }
 
 
+def default_scan_blocks(preset: str) -> bool:
+    """Per-preset scan-vs-unrolled default. l14 measured 250.1 img/s/chip
+    fully unrolled vs 194.3 under lax.scan on v5e (batch 32,
+    dots_attn_saveable — the scan's per-block dus-stacking caps wgrad
+    fusions at 85-100 TF/s vs 164+ unconstrained), so the bench default for
+    l14 is the unrolled path. Other presets keep the scan until their
+    ladders are measured (tiny/b16 queued; 10b_slice's HBM frontier was
+    measured under scan and unrolling changes its temp layout)."""
+    return preset != "l14"
+
+
 def default_scan_unroll(preset: str) -> int:
-    """Per-preset scan unroll. 1 (plain scan) for every preset until the
-    unroll ladder is measured on hardware: a fully-unrolled l14
-    (--no_scan_blocks) measured +29% on v5e because the scan's per-block
-    dus-stacking caps wgrad fusions, so a partial-unroll sweep is queued —
-    set measured winners here and record them in BASELINE.md."""
+    """Per-preset scan unroll (only meaningful when the scan path is used).
+    1 until the partial-unroll ladder is measured on hardware — the sweep is
+    queued; set measured winners here and record them in BASELINE.md."""
     return 1
 
 
@@ -291,6 +300,12 @@ def bench_train(args, metric_stub: str) -> None:
         kw["batch_size"] = args.batch_size
     if args.remat_policy is None:
         args.remat_policy = default_remat_policy(args.preset)
+    assert not (args.scan_blocks is False and args.scan_unroll), (
+        "--no_scan_blocks contradicts --scan_unroll (unroll is a scan knob)")
+    if args.scan_blocks is None:
+        # an explicit --scan_unroll is a request for the scan path
+        args.scan_blocks = (True if args.scan_unroll
+                            else default_scan_blocks(args.preset))
     if not args.scan_unroll:
         args.scan_unroll = default_scan_unroll(args.preset)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
@@ -336,7 +351,15 @@ def bench_train(args, metric_stub: str) -> None:
     peak = detect_peak_tflops(device_kind)
     mfu = (images_per_sec * flops_per_image) / (peak * 1e12 * n_dev)
 
-    base = read_baseline().get(args.preset, {}).get("images_per_sec_chip")
+    base_entry = read_baseline().get(args.preset, {})
+    knobs = ("batch_size", "remat_policy", "scan_blocks", "scan_unroll",
+             "grad_ckpt", "use_flash_attention")
+    # compare only like-for-like: a knob change (e.g. the scan->unrolled
+    # default flip) must not masquerade as a same-config speedup — entries
+    # missing a knob (older files) count as matching for that knob
+    same_config = all(base_entry.get(k, getattr(cfg, k)) == getattr(cfg, k)
+                      for k in knobs)
+    base = base_entry.get("images_per_sec_chip") if same_config else None
     vs_baseline = images_per_sec_chip / base if base else 1.0
     if args.write_baseline:
         write_baseline(args.preset, {
@@ -370,15 +393,19 @@ def main():
     p.add_argument("--preset", default="l14",
                    choices=["tiny", "b16", "b16_moe", "l14", "10b", "10b_slice", "data"])
     p.add_argument("--batch_size", type=int, default=0)
-    # default resolved per preset in bench_train: dots_saveable measured fastest
-    # on v5e where activations fit; the 10B flagship keeps none_saveable
-    # (minimal HBM residency is what makes it fit)
+    # default resolved per preset in bench_train: dots_attn_saveable measured
+    # fastest on v5e where activations fit (192.9 > dots_saveable 190.2 on
+    # l14); the 10B flagship keeps none_saveable (minimal HBM residency is
+    # what makes it fit)
     p.add_argument("--remat_policy", default=None,
                    choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
     p.add_argument("--no_grad_ckpt", action="store_false", dest="grad_ckpt")
     p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
-                   help="unroll blocks instead of lax.scan (A/B: the scan's "
-                        "dus-stacking constrains wgrad fusion layouts)")
+                   default=None,
+                   help="unroll blocks instead of lax.scan (the scan's "
+                        "dus-stacking constrains wgrad fusion layouts; "
+                        "default resolves per preset — see "
+                        "default_scan_blocks; --scan_unroll forces the scan)")
     p.add_argument("--scan_unroll", type=int, default=0,
                    help="blocks per scan step (0 = preset default); keeps the "
                         "stacked param tree, frees cross-block fusion")

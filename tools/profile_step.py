@@ -28,6 +28,9 @@ def main():
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--remat_policy", default=None,
                    choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
+    p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
+                   default=None)
+    p.add_argument("--scan_unroll", type=int, default=0)
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -53,7 +56,15 @@ def main():
     if args.batch_size:
         kw["batch_size"] = args.batch_size
     remat = args.remat_policy or default_remat_policy(args.preset)
+    # share the bench's per-preset scan defaults so traces explain exactly
+    # the configs the bench measures
+    from bench import default_scan_blocks, default_scan_unroll
+    if args.scan_blocks is None:
+        args.scan_blocks = (True if args.scan_unroll
+                            else default_scan_blocks(args.preset))
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=remat,
+                 scan_blocks=args.scan_blocks,
+                 scan_unroll=args.scan_unroll or default_scan_unroll(args.preset),
                  **kw).validate()
 
     mesh = build_mesh(cfg)
