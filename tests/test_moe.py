@@ -81,6 +81,81 @@ def test_aux_loss_uniform_router_is_one():
     np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
 
 
+def test_top2_routing_hand_case():
+    """Top-2: both chosen experts contribute with renormalized gates; second
+    choices queue behind ALL first choices of that expert for capacity
+    (GShard order). Hand-verifiable 2-expert case with identity-ish experts."""
+    d, e, n = 4, 2, 2
+    moe = MoeMlp(num_experts=e, hidden_dim=4, out_dim=d, top_k=2,
+                 capacity_factor=float(n), dtype=jnp.float32)  # C = n: no drops
+    x = jax.random.normal(jax.random.key(6), (1, n, d), jnp.float32)
+    params = moe.init(jax.random.key(7), x)
+    # router: token probs fixed at [0.75, 0.25] for every token
+    params["params"]["router"]["kernel"] = jnp.zeros((d, e))
+    params["params"]["router"]["bias"] = jnp.log(jnp.array([3.0, 1.0]))
+    out = moe.apply(params, x)
+
+    # expected: renormalized gates 0.75/0.25; expert e applies its own MLP
+    def expert(i, v):
+        p = params["params"]
+        h = v @ p["w1"][i] + p["b1"][i]
+        h = jax.nn.gelu(h, approximate=False)
+        return h @ p["w2"][i] + p["b2"][i]
+
+    want = 0.75 * expert(0, x) + 0.25 * expert(1, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_top2_second_choice_capacity_queue():
+    """First choices rank before ALL second choices for capacity (GShard
+    order — the count1 offset in vitax/models/moe.py): token 0 first-chooses
+    expert 0 while token 1 second-chooses it; at capacity 1, token 1's
+    second choice must lose the slot to token 0's first choice EVEN THOUGH
+    either alone would fit. Symmetrically for expert 1. Dropping the offset
+    (plain per-choice cumsum) would instead keep both second choices and
+    make this fail."""
+    d, e, n = 4, 2, 2
+    moe = MoeMlp(num_experts=e, hidden_dim=4, out_dim=d, top_k=2,
+                 capacity_factor=1.0, dtype=jnp.float32)  # C = ceil(2/2) = 1
+    # token 0 = +e1 basis, token 1 = -e1: router kernel [s, -s] makes token
+    # 0's probs [.75, .25] (first choice expert 0) and token 1's [.25, .75]
+    x = jnp.zeros((1, n, d)).at[0, 0, 0].set(1.0).at[0, 1, 0].set(-1.0)
+    params = moe.init(jax.random.key(9), x)
+    s = float(np.log(3.0) / 2.0)
+    params["params"]["router"]["kernel"] = jnp.zeros((d, e)).at[0, 0].set(
+        s).at[0, 1].set(-s)
+    params["params"]["router"]["bias"] = jnp.zeros((e,))
+    out = moe.apply(params, x)
+
+    def expert(i, v):
+        p = params["params"]
+        h = v @ p["w1"][i] + p["b1"][i]
+        h = jax.nn.gelu(h, approximate=False)
+        return h @ p["w2"][i] + p["b2"][i]
+
+    # each token keeps only its FIRST choice (gate .75); its second choice
+    # was evicted by the other token's first choice
+    want0 = 0.75 * expert(0, x[:, 0])
+    want1 = 0.75 * expert(1, x[:, 1])
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(want0[0]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[0, 1]), np.asarray(want1[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_top2_train_step_ep_matches_dp(devices8):
+    """Top-2 trajectories must be mesh-invariant too (ep-sharded == dp)."""
+    from tests.test_train_smoke import run_steps
+
+    cfg_ep = moe_cfg(moe_top_k=2)
+    cfg_dp = moe_cfg(moe_top_k=2, ep_size=1, dp_size=2, fsdp_size=-1)
+    _, losses_ep = run_steps(cfg_ep, n_steps=3)
+    _, losses_dp = run_steps(cfg_dp, n_steps=3)
+    assert all(np.isfinite(losses_ep))
+    np.testing.assert_allclose(losses_ep, losses_dp, rtol=2e-4)
+
+
 def test_expert_param_sharding(devices8):
     """Expert weights carry "ep" on the experts dim (after the stacked layer
     dim under scan); the router and dense params never do."""

@@ -76,6 +76,7 @@ class Config:
     ep_size: int = 1                    # expert-parallel axis (also carries batch; experts sharded across it)
     moe_experts: int = 0                # 0 = dense reference MLP; >0 = top-1 MoE in every block
     moe_capacity_factor: float = 1.25   # static expert capacity C = ceil(cf * tokens / experts)
+    moe_top_k: int = 1                  # 1 = Switch (top-1); 2 = GShard-style top-2 with renormalized gates
     moe_aux_weight: float = 0.01        # load-balance aux loss weight (Switch Transformer)
     scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
     scan_unroll: int = 1                # blocks per scan step: >1 frees XLA to fuse across blocks
@@ -134,6 +135,7 @@ class Config:
                 f"--moe_experts {self.moe_experts} not divisible by "
                 f"--ep_size {self.ep_size}")
         if self.moe_experts > 0:
+            assert self.moe_top_k in (1, 2), self.moe_top_k
             assert self.pp_size == 1, (
                 "--moe_experts with --pp_size > 1 is not supported (v1): the "
                 "pipeline body does not thread the MoE aux-loss collection")
@@ -193,6 +195,7 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--ep_size", type=int, default=1)
     ext.add_argument("--moe_experts", type=int, default=0)
     ext.add_argument("--moe_capacity_factor", type=float, default=1.25)
+    ext.add_argument("--moe_top_k", type=int, default=1, choices=[1, 2])
     ext.add_argument("--moe_aux_weight", type=float, default=0.01)
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
     ext.add_argument("--scan_unroll", type=int, default=1)

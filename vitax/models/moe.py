@@ -42,6 +42,7 @@ class MoeMlp(nn.Module):
     hidden_dim: int
     out_dim: int
     capacity_factor: float = 1.25
+    top_k: int = 1                  # 1 = Switch; 2 = GShard-style top-2
     dtype: Dtype = jnp.bfloat16
     # NamedSharding for the (E, B, C, D) dispatched tensor: P("ep", batch...)
     # anchors GSPMD so the dispatch/combine einsums lower to all-to-alls
@@ -62,29 +63,51 @@ class MoeMlp(nn.Module):
             name="router",
         )(x.astype(jnp.float32))                      # (B, N, E)
         probs = jax.nn.softmax(logits, axis=-1)
-        gate = jnp.max(probs, axis=-1)                # (B, N)
-        expert = jnp.argmax(probs, axis=-1)           # (B, N) int
+        gate1 = jnp.max(probs, axis=-1)               # (B, N)
+        expert1 = jnp.argmax(probs, axis=-1)          # (B, N) int
+        onehot1 = jax.nn.one_hot(expert1, e, dtype=jnp.float32)  # (B, N, E)
 
-        # --- load-balance aux loss (Switch eq. 4-6) ---
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # (B, N, E)
-        frac_tokens = jnp.mean(onehot, axis=(0, 1))             # (E,)
+        # --- load-balance aux loss (Switch eq. 4-6; GShard uses the same
+        # first-choice fractions under top-2) ---
+        frac_tokens = jnp.mean(onehot1, axis=(0, 1))            # (E,)
         mean_prob = jnp.mean(probs, axis=(0, 1))                # (E,)
         aux = e * jnp.sum(frac_tokens * mean_prob)
         self.sow("intermediates", "moe_aux_loss", aux)
 
         # --- capacity assignment: slot = rank of the token among those
-        # routed to the same expert, within its (sample) group ---
-        position = jnp.cumsum(onehot, axis=1) * onehot          # (B, N, E)
-        slot = (jnp.sum(position, axis=-1) - 1.0).astype(jnp.int32)  # (B, N)
-        keep = slot < c                                         # (B, N)
+        # routed to the same expert within its (sample) group; under top-2,
+        # ALL first choices rank before ALL second choices (GShard order) ---
+        def slots_of(onehot, offset):
+            position = jnp.cumsum(onehot, axis=1) * onehot      # (B, N, E)
+            per_expert = position + offset * onehot             # rank incl. offset
+            slot = (jnp.sum(per_expert, axis=-1) - 1.0).astype(jnp.int32)
+            return slot, slot < c                               # (B, N) each
 
-        # combine[b, n, e, c] = gate for the token's (expert, slot), 0 if
-        # dropped; dispatch is its boolean support
-        combine = ((gate * keep)[:, :, None, None]              # (B, N, 1, 1)
-                   * onehot[:, :, :, None]                      # (B, N, E, 1)
-                   * jax.nn.one_hot(slot, c,
-                                    dtype=jnp.float32)[:, :, None, :])
-        # -> (B, N, E, C)
+        def combine_of(gate, keep, onehot, slot):
+            # combine[b, n, e, c] = gate at the token's (expert, slot)
+            return ((gate * keep)[:, :, None, None]
+                    * onehot[:, :, :, None]
+                    * jax.nn.one_hot(slot, c,
+                                     dtype=jnp.float32)[:, :, None, :])
+
+        if self.top_k == 1:
+            slot1, keep1 = slots_of(onehot1, 0.0)
+            combine = combine_of(gate1, keep1, onehot1, slot1)  # (B, N, E, C)
+        else:
+            assert self.top_k == 2, self.top_k
+            probs2 = probs * (1.0 - onehot1)          # mask the first choice
+            gate2 = jnp.max(probs2, axis=-1)
+            expert2 = jnp.argmax(probs2, axis=-1)
+            onehot2 = jax.nn.one_hot(expert2, e, dtype=jnp.float32)
+            # renormalize the two gates (GShard: g_i = p_i / (p1 + p2))
+            denom = gate1 + gate2 + 1e-9
+            g1, g2 = gate1 / denom, gate2 / denom
+            slot1, keep1 = slots_of(onehot1, 0.0)
+            # second choices queue behind every first choice of that expert
+            count1 = jnp.sum(onehot1, axis=1, keepdims=True)    # (B, 1, E)
+            slot2, keep2 = slots_of(onehot2, count1)
+            combine = (combine_of(g1, keep1, onehot1, slot1)
+                       + combine_of(g2, keep2, onehot2, slot2))
         dispatch = (combine > 0).astype(self.dtype)
 
         # --- dispatch -> per-expert batches -> combine (GShard einsums) ---

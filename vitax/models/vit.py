@@ -181,6 +181,7 @@ class Block(nn.Module):
     attention_impl: Optional[Callable] = None
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
     moe_dispatch_sharding: Optional[Any] = None
 
     @nn.compact
@@ -206,6 +207,7 @@ class Block(nn.Module):
                 hidden_dim=int(d * self.mlp_ratio),
                 out_dim=d,
                 capacity_factor=self.moe_capacity_factor,
+                top_k=self.moe_top_k,
                 dtype=self.dtype,
                 dispatch_sharding=self.moe_dispatch_sharding,
                 name="moe",
@@ -270,6 +272,7 @@ class VisionTransformer(nn.Module):
     attention_impl: Optional[Callable] = None
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
     moe_dispatch_sharding: Optional[Any] = None
     # NamedSharding for (B, N, D) activations — anchors GSPMD batch sharding
     # and shards the token axis over "sp" for sequence parallelism
@@ -289,6 +292,7 @@ class VisionTransformer(nn.Module):
             attention_impl=self.attention_impl,
             moe_experts=self.moe_experts,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_top_k=self.moe_top_k,
             moe_dispatch_sharding=self.moe_dispatch_sharding,
         )
 
@@ -381,6 +385,7 @@ def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
         attention_impl=attention_impl,
         moe_experts=cfg.moe_experts,
         moe_capacity_factor=cfg.moe_capacity_factor,
+        moe_top_k=cfg.moe_top_k,
         moe_dispatch_sharding=moe_dispatch_sharding,
         token_sharding=token_sharding,
     )
