@@ -1,10 +1,16 @@
 #!/usr/bin/env python3
 """vitax benchmark: images/sec/chip + MFU for the training step.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
-Fail-soft: backend-init failures/hangs are caught (3 retries with backoff, a
-probe timeout, and a global watchdog) and still emit the JSON contract with an
-"error" field — a down TPU must never cost the round its data point.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+(vs_baseline is null when nothing comparable exists: no stored baseline, or a
+knob set differing from the stored one).
+Fail-soft and outage-proof: backend init is probed in fresh subprocesses on a
+wait-for-chip loop (one probe per ~60s, up to --init_patience seconds; a hung
+probe is killed, never poisons the parent) plus a global watchdog that
+stretches by the init wait. Every error path still emits the JSON contract
+with an "error" field AND the preset's last chip-measured numbers
+("last_measured", from BASELINE_MEASURED.json) — a down TPU must never cost
+the round its data point.
 
 Default config is ViT-L/14 (BASELINE.json config 3 shape) sized for one chip;
 --preset tiny|b16|l14|10b selects others; --preset data benchmarks the host
@@ -18,8 +24,10 @@ recompute is NOT counted as useful work (true MFU).
 """
 
 import argparse
+import datetime
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -45,9 +53,19 @@ def emit(result: dict) -> None:
         print(json.dumps(result), flush=True)
 
 
-def emit_error(metric: str, error: str, unit: str = "images/sec/chip") -> None:
-    emit({"metric": metric, "value": 0.0, "unit": unit,
-          "vs_baseline": 0.0, "error": error})
+def emit_error(metric: str, error: str, unit: str = "images/sec/chip",
+               preset: str = None, extra: dict = None) -> None:
+    """Error JSON still carries the last chip-measured numbers for the preset
+    (VERDICT r3 item 1): a dead chip must never yield a bare 0.0."""
+    result = {"metric": metric, "value": 0.0, "unit": unit,
+              "vs_baseline": None, "error": error}
+    if preset:
+        entry = read_baseline().get(preset)
+        if entry:
+            result["last_measured"] = entry
+    if extra:
+        result.update(extra)
+    emit(result)
 
 
 def read_baseline() -> dict:
@@ -62,6 +80,8 @@ def read_baseline() -> dict:
 
 def write_baseline(preset: str, entry: dict) -> None:
     base = read_baseline()
+    entry = dict(entry, measured_at=datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds"))
     base[preset] = entry
     tmp = BASELINE_FILE + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:  # tmp+rename: a watchdog os._exit mid-write
@@ -70,48 +90,154 @@ def write_baseline(preset: str, entry: dict) -> None:
     os.replace(tmp, BASELINE_FILE)
 
 
-def init_backend(metric: str, probe_timeout: float, retries: int = 3):
-    """Initialize the JAX backend fail-soft.
+def _probe_backend_subprocess(timeout: float):
+    """Probe backend init in a FRESH subprocess.
 
-    Returns (device_count, device_kind) or emits an error JSON and exits 0.
-    The probe runs in a daemon thread so a hung PJRT transport (e.g. a dead
-    axon tunnel — the round-1 failure mode, BENCH_r01.json) turns into a
-    timeout, not a silent hang past the driver's patience.
+    A hung PJRT transport (dead axon tunnel — the round-1 and round-3 failure
+    mode, BENCH_r01/r03.json) poisons the process that attempted it: the C
+    call holds the backend lock, so in-process retry is pointless. A killed
+    subprocess costs nothing, so the parent can keep probing until the chip
+    returns. Returns ((n_devices, device_kind), None) or (None, error_str).
     """
-    import jax
+    code = (
+        "import json, sys\n"
+        "from vitax.platform import force_cpu_if_requested\n"
+        "force_cpu_if_requested()\n"  # probe what the parent will init
+        "import jax\n"
+        "out = {'n': jax.device_count(),"
+        " 'kind': jax.devices()[0].device_kind}\n"
+        "sys.stdout.write('\\n' + json.dumps(out) + '\\n')\n"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"probe hung >{timeout:.0f}s (killed)"
+    except OSError as e:
+        return None, f"probe spawn failed: {e}"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["<no stderr>"]
+        return None, f"probe exited rc={r.returncode}: {tail[0][:300]}"
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            out = json.loads(line)
+            return (out["n"], out["kind"]), None
+        except (json.JSONDecodeError, KeyError, TypeError):
+            continue  # TypeError: a stray JSON-scalar line (e.g. "3")
+    return None, "probe produced no parseable output"
+
+
+# Seconds init_backend spent waiting for the chip; the watchdog adds this to
+# its deadline so patience spent surviving an outage can't kill the run.
+_init_waited = 0.0
+
+
+def init_backend(metric: str, probe_timeout: float, init_patience: float,
+                 preset: str = None):
+    """Initialize the JAX backend fail-soft, outage-proof.
+
+    Probes init in fresh subprocesses on a bounded wait-for-chip loop (one
+    probe per ~probe_interval, up to init_patience seconds total), then — and
+    only then — initializes in-process. A healthy chip pays one duplicate
+    init (~10-20s, the probe subprocess) — deliberate: the parent process
+    must stay virgin until a probe proves the tunnel healthy, because a hung
+    in-process init leaves the backend lock held forever (the r1/r3 outage
+    mode). A fast-failing in-process init (tunnel flap after a good probe)
+    loops back to probing while patience remains. Returns
+    (device_count, device_kind) or emits an error JSON (carrying
+    last_measured + retry evidence) and exits 0.
+    """
+    global _init_waited
+    from vitax.platform import force_cpu_if_requested, is_cpu_forced
+    if is_cpu_forced():
+        # pinned to host CPU: the hung-tunnel failure mode can't occur — skip
+        # the subprocess probe and init directly (CI/test/dev runs)
+        force_cpu_if_requested()
+        import jax
+        return jax.device_count(), jax.devices()[0].device_kind
+    probe_interval = 60.0
+    t_start = time.monotonic()
+    deadline = t_start + max(init_patience, probe_timeout)
+    attempt = 0
     last_err = "unknown"
-    delay = 5.0
-    for attempt in range(1, retries + 1):
+
+    def give_up(stage: str):
+        waited = time.monotonic() - t_start
+        emit_error(
+            metric,
+            f"backend unavailable after {attempt} probe attempts over "
+            f"{waited:.0f}s (patience {init_patience:.0f}s); {stage}: {last_err}",
+            preset=preset,
+            extra={"probe_attempts": attempt,
+                   "probe_waited_sec": round(waited, 1)})
+        os._exit(0)
+
+    def credit(upcoming: float):
+        # publish live progress BEFORE each blocking interval (probe, sleep):
+        # the watchdog stretches by this, so patience spent waiting out an
+        # outage can't convert into a watchdog kill mid-wait. Pre-crediting
+        # the upcoming block is safe — on success the exact value is set.
+        global _init_waited
+        _init_waited = (time.monotonic() - t_start) + upcoming
+
+    while True:
+        attempt += 1
+        t_probe = time.monotonic()
+        credit(probe_timeout)
+        ok, err = _probe_backend_subprocess(probe_timeout)
+        if ok is None:
+            last_err = err
+            print(f"bench: backend probe {attempt} failed ({err}); "
+                  f"{deadline - time.monotonic():.0f}s of patience left",
+                  file=sys.stderr, flush=True)
+            # next probe no sooner than probe_interval after the last one
+            # STARTED (a hung probe already burned its interval)
+            wait = max(0.0, probe_interval - (time.monotonic() - t_probe))
+            if time.monotonic() + wait >= deadline:
+                give_up("last probe")
+            credit(wait)
+            time.sleep(wait)
+            continue
+
+        # chip answered a fresh-process probe; init in-process (guarded — the
+        # tunnel may flap between the probe and this init, and a hung
+        # in-process init is unrecoverable by design)
+        import jax
         result = {}
 
-        def probe():
+        def init():
             try:
                 result["n"] = jax.device_count()
                 result["kind"] = jax.devices()[0].device_kind
             except Exception as e:  # noqa: BLE001 — fail-soft by contract
                 result["err"] = f"{type(e).__name__}: {e}"
 
-        t = threading.Thread(target=probe, daemon=True)
+        t = threading.Thread(target=init, daemon=True)
         t.start()
+        credit(probe_timeout)
         t.join(probe_timeout)
         if "n" in result:
+            _init_waited = time.monotonic() - t_start
             return result["n"], result["kind"]
         if t.is_alive():
-            # hung inside PJRT init: in-process retry is pointless (the C call
-            # holds the backend lock) — emit and bail
-            emit_error(metric, f"backend init timed out after {probe_timeout:.0f}s "
-                               f"(attempt {attempt}/{retries})")
-            os._exit(0)
-        last_err = result.get("err", last_err)
-        if attempt < retries:
-            try:  # drop the cached failure so the next attempt re-initializes
-                jax.extend.backend.clear_backends()
-            except Exception:  # noqa: BLE001
-                pass
-            time.sleep(delay)
-            delay *= 2
-    emit_error(metric, f"backend init failed after {retries} attempts: {last_err}")
-    os._exit(0)
+            # hung in-process: the backend lock is held forever — no retry
+            # is possible in this process, whatever patience remains
+            last_err = f"in-process init hung >{probe_timeout:.0f}s"
+            give_up(f"after good probe {attempt}")
+        # fast in-process failure (flap): clear the cached failure and loop
+        # back to probing while patience remains
+        last_err = f"in-process init failed after good probe: " \
+                   f"{result.get('err', 'unknown')}"
+        print(f"bench: {last_err}; re-probing", file=sys.stderr, flush=True)
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:  # noqa: BLE001
+            pass
+        if time.monotonic() + probe_interval >= deadline:
+            give_up("last in-process attempt")
+        credit(probe_interval)
+        time.sleep(probe_interval)
 
 
 def detect_peak_tflops(device_kind: str) -> float:
@@ -172,6 +298,20 @@ def default_scan_unroll(preset: str) -> int:
     return 1
 
 
+def resolve_scan_knobs(scan_blocks, scan_unroll: int, preset: str):
+    """Resolve the (scan_blocks, scan_unroll) pair from CLI values + per-preset
+    defaults. Shared with tools/profile_step.py so traces explain exactly the
+    configs the bench measures."""
+    assert not (scan_blocks is False and scan_unroll), (
+        "--no_scan_blocks contradicts --scan_unroll (unroll is a scan knob)")
+    if scan_blocks is None:
+        # an explicit --scan_unroll is a request for the scan path
+        scan_blocks = True if scan_unroll else default_scan_blocks(preset)
+    if not scan_unroll:
+        scan_unroll = default_scan_unroll(preset)
+    return scan_blocks, scan_unroll
+
+
 def default_remat_policy(preset: str) -> str:
     """Per-preset remat default (measured on v5e l14: dots_attn_saveable 192.9
     > dots_saveable 190.2 > none_saveable img/s/chip; the 10B flagship keeps
@@ -207,7 +347,7 @@ def bench_data_pipeline(args) -> None:
     if not _native_available():
         emit_error("host data pipeline images/sec (native C++ decode+augment)",
                    "native library unavailable (C++ toolchain missing or "
-                   "build failed)", unit="images/sec")
+                   "build failed)", unit="images/sec", preset="data")
         return
 
     rng = np.random.default_rng(0)
@@ -241,8 +381,8 @@ def bench_data_pipeline(args) -> None:
 
     baseline = read_baseline()
     base = baseline.get("data", {})
-    vs = native_ips / base["native_images_per_sec"] if base.get(
-        "native_images_per_sec") else 1.0
+    vs = (round(native_ips / base["native_images_per_sec"], 4)
+          if base.get("native_images_per_sec") else None)
     if args.write_baseline:
         # the data->train link (VERDICT round-2 weakness 6): for every train
         # preset already measured, record whether ONE host's native pipeline
@@ -267,7 +407,7 @@ def bench_data_pipeline(args) -> None:
                   f"{args.data_threads} threads; PIL fallback={pil_ips:.0f})",
         "value": round(native_ips, 1),
         "unit": "images/sec",
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": vs,
     })
 
 
@@ -282,7 +422,8 @@ def _native_available() -> bool:
 def bench_train(args, metric_stub: str) -> None:
     import jax
 
-    n_dev, device_kind = init_backend(metric_stub, args.probe_timeout)
+    n_dev, device_kind = init_backend(metric_stub, args.probe_timeout,
+                                      args.init_patience, preset=args.preset)
 
     import jax.numpy as jnp
     import numpy as np
@@ -300,14 +441,8 @@ def bench_train(args, metric_stub: str) -> None:
         kw["batch_size"] = args.batch_size
     if args.remat_policy is None:
         args.remat_policy = default_remat_policy(args.preset)
-    assert not (args.scan_blocks is False and args.scan_unroll), (
-        "--no_scan_blocks contradicts --scan_unroll (unroll is a scan knob)")
-    if args.scan_blocks is None:
-        # an explicit --scan_unroll is a request for the scan path
-        args.scan_blocks = (True if args.scan_unroll
-                            else default_scan_blocks(args.preset))
-    if not args.scan_unroll:
-        args.scan_unroll = default_scan_unroll(args.preset)
+    args.scan_blocks, args.scan_unroll = resolve_scan_knobs(
+        args.scan_blocks, args.scan_unroll, args.preset)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll,
@@ -360,7 +495,10 @@ def bench_train(args, metric_stub: str) -> None:
     same_config = all(base_entry.get(k, getattr(cfg, k)) == getattr(cfg, k)
                       for k in knobs)
     base = base_entry.get("images_per_sec_chip") if same_config else None
-    vs_baseline = images_per_sec_chip / base if base else 1.0
+    # None (JSON null) whenever there is nothing comparable: differing knob
+    # sets AND missing/never-measured baselines must be visible, not
+    # masquerade as "exactly matches baseline" (ADVICE r3)
+    vs_baseline = round(images_per_sec_chip / base, 4) if base else None
     if args.write_baseline:
         write_baseline(args.preset, {
             "images_per_sec_chip": round(images_per_sec_chip, 2),
@@ -384,7 +522,7 @@ def bench_train(args, metric_stub: str) -> None:
                   f"step_time={step_time * 1e3:.1f}ms, remat={cfg.remat_policy})",
         "value": round(images_per_sec_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline": vs_baseline,
     })
 
 
@@ -419,8 +557,12 @@ def main():
                    help="0 = one per CPU core (oversubscription only hurts)")
     p.add_argument("--write_baseline", action="store_true",
                    help="persist measured numbers into BASELINE_MEASURED.json")
-    p.add_argument("--probe_timeout", type=float, default=180.0,
-                   help="seconds to wait for backend init per attempt")
+    p.add_argument("--probe_timeout", type=float, default=120.0,
+                   help="seconds to wait for backend init per probe attempt")
+    p.add_argument("--init_patience", type=float, default=900.0,
+                   help="total seconds to keep re-probing a down backend in "
+                        "fresh subprocesses before giving up (outage-proofing:"
+                        " the axon tunnel returns mid-window)")
     p.add_argument("--watchdog", type=float, default=1500.0,
                    help="hard deadline: emit an error JSON and exit if the "
                         "bench has not finished by then (0 disables)")
@@ -435,9 +577,18 @@ def main():
 
     if args.watchdog > 0:
         def deadline():
-            time.sleep(args.watchdog)
-            emit_error(metric_stub, f"watchdog: bench exceeded {args.watchdog:.0f}s",
-                       unit=unit)
+            # the deadline stretches by whatever init_backend spent waiting
+            # out an outage — patience must not convert into a watchdog kill
+            t0 = time.monotonic()
+            while True:
+                remaining = args.watchdog + _init_waited - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                time.sleep(min(remaining, 10.0))
+            emit_error(metric_stub,
+                       f"watchdog: bench exceeded {args.watchdog:.0f}s "
+                       f"(+{_init_waited:.0f}s init wait)",
+                       unit=unit, preset=args.preset)
             os._exit(0)
         threading.Thread(target=deadline, daemon=True).start()
 
@@ -451,7 +602,8 @@ def main():
     except Exception as e:  # noqa: BLE001 — the JSON contract must always print
         import traceback
         traceback.print_exc(file=sys.stderr)
-        emit_error(metric_stub, f"{type(e).__name__}: {e}", unit=unit)
+        emit_error(metric_stub, f"{type(e).__name__}: {e}", unit=unit,
+                   preset=args.preset)
 
 
 if __name__ == "__main__":

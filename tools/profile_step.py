@@ -56,15 +56,11 @@ def main():
     if args.batch_size:
         kw["batch_size"] = args.batch_size
     remat = args.remat_policy or default_remat_policy(args.preset)
-    # share the bench's per-preset scan defaults so traces explain exactly
-    # the configs the bench measures
-    from bench import default_scan_blocks, default_scan_unroll
-    if args.scan_blocks is None:
-        args.scan_blocks = (True if args.scan_unroll
-                            else default_scan_blocks(args.preset))
+    from bench import resolve_scan_knobs
+    args.scan_blocks, args.scan_unroll = resolve_scan_knobs(
+        args.scan_blocks, args.scan_unroll, args.preset)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=remat,
-                 scan_blocks=args.scan_blocks,
-                 scan_unroll=args.scan_unroll or default_scan_unroll(args.preset),
+                 scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,
                  **kw).validate()
 
     mesh = build_mesh(cfg)

@@ -121,8 +121,11 @@ class Config:
                 "--no_reshard_after_forward (ZeRO-2) under --pp_size > 1 "
                 "with fsdp sharding is not supported: the pipeline body "
                 "gathers each block's shards just-in-time (ZeRO-3 "
-                "semantics) and a step-top full gather would defeat that "
-                "(with --fsdp_size 1 the flag is a no-op and allowed)")
+                "semantics) and a step-top full gather would defeat that. "
+                "With --fsdp_size 1 the flag is a no-op and allowed; "
+                "--fsdp_size -1 is treated as sharded here (validate() runs "
+                "before the device count is known) — pass an explicit "
+                "--fsdp_size 1 if the remaining mesh is a single device")
             assert self.num_blocks % self.pp_size == 0, (
                 f"--num_blocks {self.num_blocks} not divisible by --pp_size {self.pp_size}")
             assert max(self.pos_dropout, self.att_dropout, self.mlp_dropout) == 0.0, (
@@ -136,6 +139,10 @@ class Config:
                 f"--ep_size {self.ep_size}")
         if self.moe_experts > 0:
             assert self.moe_top_k in (1, 2), self.moe_top_k
+            assert self.moe_top_k <= self.moe_experts, (
+                f"--moe_top_k {self.moe_top_k} > --moe_experts "
+                f"{self.moe_experts}: the second choice would be a dead "
+                f"branch with gate ~0")
             assert self.pp_size == 1, (
                 "--moe_experts with --pp_size > 1 is not supported (v1): the "
                 "pipeline body does not thread the MoE aux-loss collection")

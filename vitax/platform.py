@@ -11,7 +11,12 @@ import os
 import jax
 
 
+def is_cpu_forced() -> bool:
+    """Whether this process is pinned to host CPU (JAX_PLATFORMS=cpu)."""
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
 def force_cpu_if_requested() -> None:
     """Honor JAX_PLATFORMS=cpu even when a TPU plugin would claim the backend."""
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    if is_cpu_forced():
         jax.config.update("jax_platforms", "cpu")

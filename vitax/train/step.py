@@ -91,8 +91,14 @@ def make_train_step(
             rngs = {"dropout": rng} if dropout else None
             logits, cols = model.apply(params, images, not dropout,
                                        rngs=rngs, mutable=["intermediates"])
-            aux = sum(jnp.sum(a) for a in jax.tree.leaves(cols))
-            aux = aux / cfg.num_blocks
+            # select the moe_aux_loss sows BY NAME: any future sow into
+            # "intermediates" (e.g. a debug metric) must not silently join
+            # the training objective (ADVICE r3)
+            aux_leaves = [
+                leaf for path, leaf in jax.tree_util.tree_leaves_with_path(cols)
+                if any(getattr(k, "key", None) == "moe_aux_loss" for k in path)]
+            assert aux_leaves, "moe_experts > 0 but no moe_aux_loss was sown"
+            aux = sum(jnp.sum(a) for a in aux_leaves) / cfg.num_blocks
         elif dropout:
             logits = model.apply(params, images, False, rngs={"dropout": rng})
         else:

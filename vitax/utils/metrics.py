@@ -1,4 +1,7 @@
-"""Windowed smoothed meters (reference SmoothedValue parity, utils.py:60-102)."""
+"""Windowed smoothed meters (capability parity with reference SmoothedValue,
+utils.py:60-102, itself adapted from facebookresearch/mmf — same public API,
+original internals: one ring buffer of (value, weight) samples plus running
+totals, no numpy)."""
 
 from __future__ import annotations
 
@@ -6,34 +9,33 @@ from collections import deque
 
 
 class SmoothedValue:
-    """Track a series of values; expose median / windowed batch-weighted avg /
-    global avg / latest. Capability parity with reference utils.py:60-102
-    (itself adapted from facebookresearch/mmf), without the numpy dependency."""
+    """Track a weighted series; expose windowed median (unweighted), windowed
+    weighted average, global weighted average, and the latest raw value."""
 
     def __init__(self, window_size: int = 20):
         self.window_size = window_size
         self.reset()
 
     def reset(self) -> None:
-        self.deque = deque(maxlen=self.window_size)            # value * batch_size
-        self.averaged_value_deque = deque(maxlen=self.window_size)  # raw values
-        self.batch_sizes = deque(maxlen=self.window_size)
-        self.total_samples = 0
-        self.total = 0.0
-        self.count = 0
+        self._window = deque(maxlen=self.window_size)  # (value, weight) pairs
+        self._sum = 0.0     # lifetime sum of value * weight
+        self._weight = 0    # lifetime sum of weights
+        self._n = 0         # lifetime number of updates
 
     def update(self, value: float, batch_size: int = 1) -> None:
         value = float(value)
-        self.deque.append(value * batch_size)
-        self.averaged_value_deque.append(value)
-        self.batch_sizes.append(batch_size)
-        self.count += 1
-        self.total_samples += batch_size
-        self.total += value * batch_size
+        self._window.append((value, batch_size))
+        self._sum += value * batch_size
+        self._weight += batch_size
+        self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
 
     @property
     def median(self) -> float:
-        vals = sorted(self.averaged_value_deque)
+        vals = sorted(v for v, _ in self._window)
         n = len(vals)
         if n == 0:
             return float("nan")
@@ -42,12 +44,14 @@ class SmoothedValue:
 
     @property
     def avg(self) -> float:
-        denom = sum(self.batch_sizes)
-        return sum(self.deque) / denom if denom else float("nan")
+        denom = sum(w for _, w in self._window)
+        if not denom:
+            return float("nan")
+        return sum(v * w for v, w in self._window) / denom
 
     @property
     def global_avg(self) -> float:
-        return self.total / self.total_samples if self.total_samples else float("nan")
+        return self._sum / self._weight if self._weight else float("nan")
 
     def get_latest(self) -> float:
-        return self.averaged_value_deque[-1]
+        return self._window[-1][0]
