@@ -217,3 +217,41 @@ def test_moe_config_validation():
         moe_cfg(moe_experts=3)
     with pytest.raises(AssertionError):  # moe + pp unsupported (v1)
         moe_cfg(ep_size=1, pp_size=2, fsdp_size=1, dp_size=4)
+
+
+@pytest.mark.slow
+def test_moe_ep_partitioner_has_no_involuntary_remat():
+    """The ep-sharded mesh must compile without GSPMD's "Involuntary full
+    rematerialization" fallback (VERDICT r3 item 4: the replicate-then-
+    repartition path costs real HBM bandwidth on a pod). The warning is
+    emitted by XLA's C++ logging, so it is captured from a subprocess's
+    stderr. Guarded by the activation anchors in vitax/models/vit.py
+    (block-entry carry, qkv output, pooled head input) and moe.py
+    (dispatch/combine + token re-anchor)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; sys.path.insert(0, '.')\n"
+        "import jax\n"
+        "from vitax.platform import force_cpu_if_requested\n"
+        "force_cpu_if_requested()\n"
+        "import __graft_entry__ as g\n"
+        "mesh, losses = g._dryrun_one(8, 1, moe_experts=4, dp_size=2,\n"
+        "                             fsdp_size=-1, ep_size=2)\n"
+        "print('ok', mesh, losses)\n"
+    )
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU run: skip TPU plugin dial
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=480, env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ok" in r.stdout, r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, (
+        "GSPMD fell back to replicate-then-repartition under the ep mesh:\n"
+        + "\n".join(l for l in r.stderr.splitlines() if "Involuntary" in l))

@@ -48,6 +48,10 @@ class MoeMlp(nn.Module):
     # anchors GSPMD so the dispatch/combine einsums lower to all-to-alls
     # instead of the partitioner's "involuntary full rematerialization"
     dispatch_sharding: Optional[Any] = None
+    # NamedSharding for (B, N, D) activations: the combine einsum's output is
+    # anchored back to the block's token layout so the residual add and the
+    # next block see the batch-sharded form, not an expert-flavored remnant
+    token_sharding: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
@@ -127,4 +131,7 @@ class MoeMlp(nn.Module):
         if self.dispatch_sharding is not None:
             ye = jax.lax.with_sharding_constraint(ye, self.dispatch_sharding)
 
-        return jnp.einsum("bnec,ebcd->bnd", combine.astype(self.dtype), ye)
+        out = jnp.einsum("bnec,ebcd->bnd", combine.astype(self.dtype), ye)
+        if self.token_sharding is not None:
+            out = jax.lax.with_sharding_constraint(out, self.token_sharding)
+        return out

@@ -35,6 +35,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from vitax.config import Config
 
@@ -88,6 +89,13 @@ class Attention(nn.Module):
     proj_dropout: float = 0.0
     dtype: Dtype = jnp.bfloat16
     attention_impl: Optional[Callable[[Array, Array, Array], Array]] = None
+    # NamedSharding anchor for the (B, N, 3D) qkv projection output. Without
+    # it, a batch spanning 3 mesh axes (dp x fsdp x ep — the MoE meshes)
+    # makes GSPMD keep the qkv weight fsdp-sharded instead of all-gathering
+    # it (ZeRO-3), and the feature-sharded dot output then triggers
+    # "involuntary full rematerialization" at this add (MULTICHIP_r03 tail).
+    # Feature axis carries "tp" under tensor parallelism (Megatron layout).
+    qkv_sharding: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
@@ -103,6 +111,8 @@ class Attention(nn.Module):
             bias_init=nn.initializers.zeros,
             name="qkv",
         )(x)
+        if self.qkv_sharding is not None:
+            qkv = jax.lax.with_sharding_constraint(qkv, self.qkv_sharding)
         qkv = qkv.reshape(b, n, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # each (B, N, H, Dh)
 
@@ -183,10 +193,26 @@ class Block(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 1
     moe_dispatch_sharding: Optional[Any] = None
+    token_sharding: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
         d = x.shape[-1]
+        if self.token_sharding is not None:
+            # re-anchor the carry at every block entry: under the ep mesh the
+            # MoE combine einsum hands the next block a partially-sharded
+            # layout and the partitioner falls back to involuntary full
+            # rematerialization at the qkv projection (MULTICHIP_r03 tail)
+            x = jax.lax.with_sharding_constraint(x, self.token_sharding)
+        qkv_sharding = None
+        if self.token_sharding is not None:
+            # qkv output anchor derived from the activation sharding: same
+            # batch/token layout, feature over "tp" when tensor parallelism
+            # is active (Megatron layout; the proj output returns to full)
+            ts = self.token_sharding
+            tp_ax = "tp" if ts.mesh.shape.get("tp", 1) > 1 else None
+            qkv_sharding = NamedSharding(
+                ts.mesh, P(ts.spec[0], ts.spec[1], tp_ax))
         # timm Block default norm_layer is nn.LayerNorm with eps=1e-5 when
         # constructed directly (as the reference does).
         y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32, name="norm1")(x)
@@ -196,6 +222,7 @@ class Block(nn.Module):
             proj_dropout=self.mlp_dropout,
             dtype=self.dtype,
             attention_impl=self.attention_impl,
+            qkv_sharding=qkv_sharding,
             name="attn",
         )(y, deterministic=deterministic)
         x = x + y
@@ -210,6 +237,7 @@ class Block(nn.Module):
                 top_k=self.moe_top_k,
                 dtype=self.dtype,
                 dispatch_sharding=self.moe_dispatch_sharding,
+                token_sharding=self.token_sharding,
                 name="moe",
             )(y, deterministic=deterministic)
         else:
@@ -294,6 +322,7 @@ class VisionTransformer(nn.Module):
             moe_capacity_factor=self.moe_capacity_factor,
             moe_top_k=self.moe_top_k,
             moe_dispatch_sharding=self.moe_dispatch_sharding,
+            token_sharding=self.token_sharding,
         )
 
     @nn.compact
@@ -350,6 +379,14 @@ class VisionTransformer(nn.Module):
 
         x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32, name="norm")(x)
         x = jnp.mean(x, axis=1)  # mean-pool over sequence (arXiv:2106.04560)
+        if self.token_sharding is not None:
+            # anchor the pooled (B, D) activations batch-sharded; the
+            # constraint transposes onto the backward cotangent, where the
+            # head-dot otherwise leaves D fsdp-sharded under 3-axis-batch
+            # meshes and forces an involuntary full rematerialization
+            ts = self.token_sharding
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(ts.mesh, P(ts.spec[0], None)))
         logits = nn.Dense(
             self.num_classes,
             dtype=jnp.float32,  # head + loss in float32

@@ -83,6 +83,9 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     bk = model.block_kwargs()
     bk["attention_impl"] = getattr(
         bk["attention_impl"], "vitax_local_impl", bk["attention_impl"])
+    # mesh-level sharding anchors are meaningless on the per-device values
+    # inside shard_map (and NamedSharding constraints are illegal there)
+    bk["token_sharding"] = None
     block = Block(**bk)
 
     # per-layer specs: drop the leading (stacked/"pp") dim of each leaf spec

@@ -29,6 +29,18 @@ def _needs_dropout(cfg: Config) -> bool:
     return (cfg.pos_dropout > 0) or (cfg.att_dropout > 0) or (cfg.mlp_dropout > 0)
 
 
+def _make_logits_anchor(mesh: Mesh):
+    """Anchor (B, C) logits batch-sharded: under 3-axis-batch meshes (dp x
+    fsdp x ep) the CE softmax backward and the eval argmax iota otherwise
+    land on mixed layouts the partitioner reaches only by involuntary full
+    rematerialization (same family as the activation anchors in
+    vitax/models/vit.py). Identity on single-device meshes."""
+    if mesh.size == 1:
+        return lambda logits: logits
+    sharding = NamedSharding(mesh, P(batch_pspec()[0], None))
+    return lambda logits: jax.lax.with_sharding_constraint(logits, sharding)
+
+
 def _forward_fn(cfg: Config, model, mesh: Mesh, state_specs=None):
     """The deterministic forward: model.apply, or the GPipe pipeline over the
     "pp" mesh axis when --pp_size > 1 (vitax/parallel/pipeline.py — same
@@ -81,6 +93,7 @@ def make_train_step(
     forward = _forward_fn(cfg, model, mesh, state_specs)
 
     moe = cfg.moe_experts > 0
+    anchor_logits = _make_logits_anchor(mesh)
 
     def loss_fn(params, batch, rng):
         images = prepare_images(batch["image"])
@@ -104,7 +117,7 @@ def make_train_step(
         else:
             logits = forward(params, images, True)
         loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch["label"]).mean()
+            anchor_logits(logits), batch["label"]).mean()
         if moe:
             loss = loss + cfg.moe_aux_weight * aux
         return loss
@@ -150,9 +163,13 @@ def make_eval_step(cfg: Config, model, mesh: Mesh, state_specs: PyTree):
     batch_sharding = NamedSharding(mesh, batch_pspec())
     forward = _forward_fn(cfg, model, mesh, state_specs)
 
+    anchor_logits = _make_logits_anchor(mesh)
+
     def eval_step(state: TrainState, batch):
         logits = forward(state.params, prepare_images(batch["image"]), True)
-        pred = jnp.argmax(logits, axis=-1)
+        # same batch-sharded logits anchor as the train loss (the argmax
+        # iota is the eval-side victim of the mixed layout)
+        pred = jnp.argmax(anchor_logits(logits), axis=-1)
         return jnp.sum((pred == batch["label"]).astype(jnp.int32))
 
     return jax.jit(
