@@ -31,6 +31,7 @@ vitax/checkpoint/consolidate.py.
 from __future__ import annotations
 
 import atexit
+import json
 import os
 import re
 from typing import Any, Optional
@@ -75,6 +76,29 @@ def epoch_ckpt_path(ckpt_dir: str, epoch: int) -> str:
     return os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
 
 
+def _resume_meta_path(ckpt_dir: str, epoch: int) -> str:
+    # NEXT to the checkpoint dir, not inside it: Orbax owns the dir's
+    # contents, and the name does not match _EPOCH_RE so latest_epoch is
+    # unaffected
+    return epoch_ckpt_path(ckpt_dir, epoch) + ".resume.json"
+
+
+def load_resume_step(ckpt_dir: str, epoch: int) -> Optional[int]:
+    """Completed steps-in-epoch recorded with a MID-epoch (preemption) save of
+    `epoch`, or None when the stored checkpoint is an epoch-boundary one.
+    The sampler order is a pure function of (seed, epoch), so this single
+    integer pins the exact resume position (vitax/data/loader.py)."""
+    path = _resume_meta_path(ckpt_dir, epoch)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            step = json.load(f)["step_in_epoch"]
+        return int(step) if step and step > 0 else None
+    except (json.JSONDecodeError, KeyError, TypeError, OSError):
+        return None  # unreadable sidecar degrades to epoch-granular resume
+
+
 def latest_epoch(ckpt_dir: str) -> Optional[int]:
     """Highest epoch with a complete checkpoint in ckpt_dir, or None."""
     if not os.path.isdir(ckpt_dir):
@@ -88,19 +112,37 @@ def latest_epoch(ckpt_dir: str) -> Optional[int]:
 
 
 def save_state(ckpt_dir: str, epoch: int, state: PyTree,
-               wait: bool = False) -> str:
+               wait: bool = False,
+               step_in_epoch: Optional[int] = None) -> str:
     """Save the train state for `epoch`; all hosts write their shards in
     parallel (reference save_ckpt with master_only=False, utils.py:24-33).
 
     Returns as soon as the device->host snapshot is taken (the state may then
     be donated to the next step); the write commits in background. wait=True
-    blocks until committed (final save / preemption-imminent path)."""
+    blocks until committed (final save / preemption-imminent path).
+
+    step_in_epoch > 0 marks a MID-epoch save (preemption at that many
+    completed steps): process 0 records it in a sidecar so resume can
+    continue inside the epoch instead of skipping its remainder. An
+    epoch-boundary save of the same epoch deletes any stale sidecar (the
+    stored state it described has been overwritten)."""
     path = epoch_ckpt_path(ckpt_dir, epoch)
     ckptr = _checkpointer()
     ckptr.save(path, state, force=True)
     if wait:
         ckptr.wait_until_finished()
-    master_print(f"checkpoint save {'committed' if wait else 'started'}: {path}")
+    if jax.process_index() == 0:
+        meta = _resume_meta_path(ckpt_dir, epoch)
+        if step_in_epoch:
+            tmp = meta + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps({"step_in_epoch": int(step_in_epoch)}))
+            os.replace(tmp, meta)  # atomic: never a half-written sidecar
+        elif os.path.exists(meta):
+            os.remove(meta)
+    master_print(f"checkpoint save {'committed' if wait else 'started'}: {path}"
+                 + (f" (mid-epoch, {step_in_epoch} steps done)"
+                    if step_in_epoch else ""))
     return path
 
 

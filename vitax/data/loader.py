@@ -104,13 +104,16 @@ class ShardedLoader:
             "label": jax.make_array_from_process_local_data(self.label_sharding, local["label"]),
         }
 
-    def epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[Dict[str, jax.Array]]:
         """Yield device batches for one epoch. `epoch` seeds the shuffle
         (train_sampler.set_epoch parity, reference run_vit_training.py:258)
-        and the per-sample augmentation randomness."""
+        and the per-sample augmentation randomness. `start_step` skips the
+        first N global batches exactly (the index matrix is a pure function
+        of (seed, epoch), so no data is loaded for the skipped steps) —
+        step-granular preemption resume (vitax/train/loop.py)."""
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
-        index_matrix = self.sampler.epoch_indices(epoch)
+        index_matrix = self.sampler.epoch_indices(epoch)[start_step:]
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 

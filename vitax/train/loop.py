@@ -79,6 +79,15 @@ def train(cfg: Config) -> TrainState:
         found = distributed.broadcast_from_process0(latest_epoch(cfg.ckpt_dir) or 0)
         cfg = dataclasses.replace(cfg, resume_epoch=found)
         master_print(f"auto-resume: {'epoch ' + str(found) if found else 'no checkpoint found, fresh start'}")
+    # step-granular resume: a mid-epoch (preemption) checkpoint carries the
+    # completed step count in a sidecar — continue INSIDE that epoch instead
+    # of skipping its remainder (improves on the reference's epoch-granular
+    # --resume_epoch contract, run_vit_training.py:246-248)
+    resume_step = 0
+    if cfg.resume_epoch > 0:
+        from vitax.checkpoint.orbax_io import load_resume_step
+        resume_step = distributed.broadcast_from_process0(
+            load_resume_step(cfg.ckpt_dir, cfg.resume_epoch) or 0)
     model = build_model(cfg, attention_impl=attention_impl,
                         token_sharding=_token_sharding(cfg, mesh),
                         moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh))
@@ -119,7 +128,8 @@ def train(cfg: Config) -> TrainState:
     try:
         state = _run_epochs(
             cfg, state, train_step, train_loader, val_loader, eval_step,
-            schedule, smoothed_loss, smoothed_time, prof)
+            schedule, smoothed_loss, smoothed_time, prof,
+            resume_step=resume_step)
     finally:
         if prof["on"]:
             jax.profiler.stop_trace()
@@ -153,14 +163,26 @@ def _preempt_agreed(step_in_epoch) -> bool:
 
 
 def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
-                schedule, smoothed_loss, smoothed_time, prof):
+                schedule, smoothed_loss, smoothed_time, prof,
+                resume_step: int = 0):
     data_rng = jax.random.key(cfg.seed + 1)
     total_steps = 0
-    for epoch in range(cfg.resume_epoch + 1, cfg.num_epochs + 1):
+    # resume_step > 0: the resume checkpoint was a mid-epoch preemption save —
+    # re-enter THAT epoch at the recorded step (the sampler order is a pure
+    # function of (seed, epoch), so the data stream continues exactly where
+    # the preempted run left off)
+    start_epoch = cfg.resume_epoch + (0 if resume_step else 1)
+    if resume_step:
+        master_print(f"step-granular resume: re-entering epoch {start_epoch} "
+                     f"at step {resume_step + 1}")
+    for epoch in range(max(start_epoch, 1), cfg.num_epochs + 1):
         master_print(f"starting epoch {epoch}")
         time_epoch_b = time_step_b = time.time()
         metrics = None
-        for step, batch in enumerate(train_loader.epoch(epoch)):
+        start_step = resume_step if epoch == start_epoch else 0
+        for step, batch in enumerate(
+                train_loader.epoch(epoch, start_step=start_step),
+                start=start_step):
             if cfg.steps_per_epoch and step >= cfg.steps_per_epoch:
                 break
             if cfg.profile_dir and total_steps == 2 and not prof["on"]:
@@ -178,19 +200,22 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             t_new = time.time()
             smoothed_time.update(t_new - time_step_b, batch_size=1)
             time_step_b = t_new
-            is_first_iter = epoch == cfg.resume_epoch + 1 and step == 0
+            # first step of THIS RUN (fresh start, epoch-granular resume, or
+            # mid-epoch resume alike): always log it — it carries the compile
+            is_first_iter = total_steps == 1
             if is_first_iter or (step + 1) % cfg.log_step_interval == 0:
                 _run_logging(cfg, epoch, step, metrics, schedule, smoothed_loss, smoothed_time)
             if _preempt_agreed(step_in_epoch=step):
                 # commit a synchronous save of the live mid-epoch state under
-                # this epoch's name, drain, and leave. Auto-resume
-                # (--resume_epoch -1) restarts at epoch+1 with the saved
-                # optimizer/step state; the remainder of this epoch's data is
-                # skipped (the framework's epoch-granular resume contract).
+                # this epoch's name (with the completed step count in the
+                # resume sidecar), drain, and leave. Auto-resume
+                # (--resume_epoch -1) restarts INSIDE this epoch at the next
+                # step — no data is skipped or repeated.
                 master_print(f"SIGTERM received: saving preemption checkpoint "
                              f"at epoch {epoch} (step {step + 1}) and exiting")
                 jax.device_get(metrics["loss"])  # fence: step must be done
-                save_state(cfg.ckpt_dir, epoch, state, wait=True)
+                save_state(cfg.ckpt_dir, epoch, state, wait=True,
+                           step_in_epoch=step + 1)
                 return state
             if cfg.max_steps and total_steps >= cfg.max_steps:
                 break
