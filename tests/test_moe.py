@@ -77,7 +77,10 @@ def test_aux_loss_uniform_router_is_one():
     params["params"]["router"]["kernel"] = jnp.zeros((d, e))
     params["params"]["router"]["bias"] = jnp.zeros((e,))
     _, cols = moe.apply(params, x, mutable=["intermediates"])
-    (aux,) = jax.tree.leaves(cols)
+    moe_cols = cols["intermediates"]["moe_frac_tokens"], \
+        cols["intermediates"]["moe_mean_prob"]
+    (frac,), (prob,) = moe_cols
+    aux = e * jnp.sum(frac * prob)
     np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
 
 
@@ -215,8 +218,10 @@ def test_moe_config_validation():
         moe_cfg(moe_experts=0)
     with pytest.raises(AssertionError):  # experts % ep
         moe_cfg(moe_experts=3)
-    with pytest.raises(AssertionError):  # moe + pp unsupported (v1)
-        moe_cfg(ep_size=1, pp_size=2, fsdp_size=1, dp_size=4)
+    with pytest.raises(AssertionError):  # moe + pp needs experts replicated
+        moe_cfg(ep_size=2, pp_size=2, fsdp_size=1, dp_size=2)
+    # moe + pp with ep=1 is supported (v2: aux ingredients ride the pipeline)
+    moe_cfg(ep_size=1, pp_size=2, fsdp_size=1, dp_size=4)
 
 
 @pytest.mark.slow

@@ -159,7 +159,58 @@ def test_pp_fsdp_train_step_matches_fsdp(devices8):
 def test_pp_config_validation():
     with pytest.raises(AssertionError):  # blocks not divisible by stages
         pp_cfg(num_blocks=3)
-    with pytest.raises(AssertionError):  # dropout unsupported under pp
-        pp_cfg(att_dropout=0.1)
     with pytest.raises(AssertionError):  # needs the stacked tree
         pp_cfg(scan_blocks=False)
+    # dropout under pp is supported in v2 (keys ride the pipeline body)
+    pp_cfg(att_dropout=0.1)
+
+
+def test_pp_moe_matches_non_pp(devices8):
+    """MoE blocks under GPipe (experts replicated): the pipeline's aux loss
+    combines the sown frac/prob ingredients across microbatches BEFORE the
+    nonlinear Switch product (vitax/parallel/pipeline.py), so the pp
+    trajectory must equal the non-pp one exactly — pp x moe was a v1
+    exclusion (VERDICT r3 item 5)."""
+    from tests.test_train_smoke import run_steps
+
+    moe_kw = dict(moe_experts=4, ep_size=1)
+    _, losses_pp = run_steps(
+        pp_cfg(pp_size=2, dp_size=4, grad_ckpt=True, **moe_kw), n_steps=4)
+    _, losses_ref = run_steps(
+        pp_cfg(pp_size=1, dp_size=1, fsdp_size=-1, grad_ckpt=True, **moe_kw),
+        n_steps=4)
+    assert all(np.isfinite(losses_pp))
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-4)
+
+
+def test_pp_dropout_deterministic_and_active(devices8):
+    """Dropout under GPipe (v1 exclusion, VERDICT r3 item 5): per-(tick,
+    layer, shard) keys folded from the step rng make the masks deterministic
+    given (seed, step) — same rng twice gives identical losses, a different
+    rng different ones — and dropout must actually bite (loss differs from
+    the deterministic path)."""
+    from tests.test_train_smoke import build_train_objects, random_batch
+
+    cfg = pp_cfg(pp_size=2, dp_size=4, att_dropout=0.2, mlp_dropout=0.2,
+                 pos_dropout=0.1, grad_ckpt=True)
+    mesh, state, step_fn, _ = build_train_objects(cfg)
+    batch = random_batch(cfg, mesh, seed=0)
+    rng_a, rng_b = jax.random.key(1), jax.random.key(2)
+
+    _, m1 = step_fn(state, batch, rng_a)
+    l1 = float(jax.device_get(m1["loss"]))
+    mesh2, state2, step_fn2, _ = build_train_objects(cfg)
+    _, m2 = step_fn2(state2, batch, rng_a)
+    l2 = float(jax.device_get(m2["loss"]))
+    assert l1 == l2, f"dropout under pp is not deterministic: {l1} vs {l2}"
+
+    mesh3, state3, step_fn3, _ = build_train_objects(cfg)
+    _, m3 = step_fn3(state3, batch, rng_b)
+    l3 = float(jax.device_get(m3["loss"]))
+    assert l1 != l3, "different step rng produced identical dropout masks"
+
+    det_cfg = pp_cfg(pp_size=2, dp_size=4, grad_ckpt=True)
+    mesh4, state4, step_fn4, _ = build_train_objects(det_cfg)
+    _, m4 = step_fn4(state4, batch, rng_a)
+    l4 = float(jax.device_get(m4["loss"]))
+    assert abs(l1 - l4) > 1e-7, "dropout under pp had no effect on the loss"

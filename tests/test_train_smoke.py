@@ -221,14 +221,17 @@ def test_sigterm_preemption_save(devices8, tmp_path):
         preempt.uninstall()
         preempt.reset()
 
-    # auto-resume picks the preemption checkpoint up and continues at epoch 2
+    # auto-resume re-enters epoch 1 AT STEP 2 (step-granular: the sidecar
+    # recorded 1 completed step) and finishes it under the new
+    # steps_per_epoch=2, then runs epoch 2 in full
     cfg2 = tiny_cfg(
         fake_data=True, num_epochs=2, steps_per_epoch=2, log_step_interval=99,
         resume_epoch=-1, ckpt_dir=str(tmp_path / "ckpt"), ckpt_epoch_interval=99,
         test_epoch_interval=99, num_workers=2, eval_max_batches=1,
     )
     state2 = train(cfg2)
-    assert int(jax.device_get(state2.step)) == 3  # 1 saved + epoch-2's 2 steps
+    # 1 saved + epoch-1's remaining 1 step + epoch-2's 2 steps
+    assert int(jax.device_get(state2.step)) == 4
 
 
 @pytest.mark.slow

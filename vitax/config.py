@@ -128,10 +128,12 @@ class Config:
                 "--fsdp_size 1 if the remaining mesh is a single device")
             assert self.num_blocks % self.pp_size == 0, (
                 f"--num_blocks {self.num_blocks} not divisible by --pp_size {self.pp_size}")
-            assert max(self.pos_dropout, self.att_dropout, self.mlp_dropout) == 0.0, (
-                "--pp_size > 1 does not thread dropout rngs through the "
-                "pipeline (v1); set dropouts to 0 (the reference defaults)")
             assert self.pp_microbatches >= 0
+            if self.moe_experts > 0:
+                assert self.ep_size == 1, (
+                    "--moe_experts under --pp_size > 1 needs experts "
+                    "replicated (--ep_size 1): expert sharding inside the "
+                    "manual pipeline body would need its own all-to-alls")
         if self.ep_size > 1:
             assert self.moe_experts > 0, "--ep_size > 1 needs --moe_experts"
             assert self.moe_experts % self.ep_size == 0, (
@@ -143,9 +145,6 @@ class Config:
                 f"--moe_top_k {self.moe_top_k} > --moe_experts "
                 f"{self.moe_experts}: the second choice would be a dead "
                 f"branch with gate ~0")
-            assert self.pp_size == 1, (
-                "--moe_experts with --pp_size > 1 is not supported (v1): the "
-                "pipeline body does not thread the MoE aux-loss collection")
         return self
 
 

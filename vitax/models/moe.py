@@ -71,12 +71,18 @@ class MoeMlp(nn.Module):
         expert1 = jnp.argmax(probs, axis=-1)          # (B, N) int
         onehot1 = jax.nn.one_hot(expert1, e, dtype=jnp.float32)  # (B, N, E)
 
-        # --- load-balance aux loss (Switch eq. 4-6; GShard uses the same
-        # first-choice fractions under top-2) ---
+        # --- load-balance aux loss ingredients (Switch eq. 4-6; GShard uses
+        # the same first-choice fractions under top-2). frac and prob are
+        # sown SEPARATELY (not pre-multiplied into the aux scalar): they are
+        # linear in the tokens, so per-microbatch means average exactly to
+        # the full-batch means — the GPipe pipeline combines them across
+        # microbatches before the nonlinear product and its aux matches the
+        # scan path's bit-for-bit (vitax/parallel/pipeline.py,
+        # vitax/train/step.py:aux_from_frac_prob) ---
         frac_tokens = jnp.mean(onehot1, axis=(0, 1))            # (E,)
         mean_prob = jnp.mean(probs, axis=(0, 1))                # (E,)
-        aux = e * jnp.sum(frac_tokens * mean_prob)
-        self.sow("intermediates", "moe_aux_loss", aux)
+        self.sow("intermediates", "moe_frac_tokens", frac_tokens)
+        self.sow("intermediates", "moe_mean_prob", mean_prob)
 
         # --- capacity assignment: slot = rank of the token among those
         # routed to the same expert within its (sample) group; under top-2,

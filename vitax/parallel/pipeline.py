@@ -32,8 +32,19 @@ instead of keeping gathered weights live: full ZeRO-3 memory semantics
 inside GPipe. Embed/head run data-parallel outside the pipeline, reusing
 the SAME param tree as the scan path functionally — init and checkpoints
 are identical between pp and non-pp topologies, so Orbax cross-topology
-restore covers pp<->fsdp resizes. Dropout is excluded under pp
-(config.validate).
+restore covers pp<->fsdp resizes.
+
+v2 additions over the original GPipe body:
+- Dropout rides the pipeline: per-(tick, layer, data-shard) keys are folded
+  from the step rng inside the body, so masks are deterministic given
+  (seed, step) and distinct across microbatches, layers, and batch shards.
+  Position dropout applies outside the shard_map (plain GSPMD).
+- MoE blocks work under pp (with experts replicated, --ep_size 1; expert
+  sharding inside the manual pipeline would need its own all-to-alls): each
+  block's sown load-balance ingredients (frac_tokens, mean_prob — LINEAR in
+  the tokens) are masked on bubble ticks, averaged over microbatches and
+  data shards, and only then combined into the nonlinear Switch aux product
+  — so the pipeline's aux equals the scan path's exactly.
 """
 
 from __future__ import annotations
@@ -56,7 +67,8 @@ def _gather_over(x, spec: P, axis_name: str):
 
 
 def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
-    """(params, images, deterministic) -> logits, GPipe-pipelined over "pp".
+    """(params, images, det=True, rng=None, with_aux=False) -> logits or
+    (logits, moe_aux), GPipe-pipelined over "pp".
 
     `model` is the same VisionTransformer the scan path uses — its param tree
     is reused leaf-for-leaf; this function only changes HOW blocks are
@@ -71,10 +83,16 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     S = mesh.shape["pp"]
     M = cfg.pp_microbatches or S
     assert cfg.num_blocks % S == 0, (cfg.num_blocks, S)
+    Lps = cfg.num_blocks // S  # layers per stage
     dp_like = (mesh.shape["dp"] * mesh.shape["fsdp"] * mesh.shape["ep"])
     assert cfg.batch_size % (dp_like * M) == 0, (
         f"batch {cfg.batch_size} must divide by data-axes*microbatches "
         f"({dp_like}*{M})")
+    moe = cfg.moe_experts > 0
+    if moe:
+        assert mesh.shape["ep"] == 1, (
+            "MoE under pp needs experts replicated (--ep_size 1)")
+    has_block_dropout = cfg.att_dropout > 0 or cfg.mlp_dropout > 0
 
     # the model's attention impl may be shard_map-wrapped (multi-device
     # meshes); inside pipeline_body we are ALREADY inside shard_map and the
@@ -86,6 +104,7 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     # mesh-level sharding anchors are meaningless on the per-device values
     # inside shard_map (and NamedSharding constraints are illegal there)
     bk["token_sharding"] = None
+    bk["moe_dispatch_sharding"] = None
     block = Block(**bk)
 
     # per-layer specs: drop the leading (stacked/"pp") dim of each leaf spec
@@ -93,62 +112,121 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     layer_specs = (None if block_specs is None else jax.tree.map(
         lambda s: P(*s[1:]), block_specs, is_leaf=is_spec))
 
-    def one_block(carry, layer_params):
-        if layer_specs is not None and mesh.shape["fsdp"] > 1:
-            # ZeRO-3 inside the pipeline: gather this block's shards over
-            # "fsdp" just-in-time (under remat this sits inside the
-            # checkpointed region, so backward re-gathers rather than
-            # holding gathered weights live; the gather's transpose
-            # reduce-scatters the weight cotangents onto the shards).
-            # NOTE specs lead the tree.map: P is a tuple subclass, so it
-            # must be the is_leaf-guarded first tree
-            layer_params = jax.tree.map(
-                lambda s, x: _gather_over(x, s, "fsdp"),
-                layer_specs, layer_params, is_leaf=is_spec)
-        return block.apply({"params": layer_params}, carry, True), None
-
-    if cfg.grad_ckpt:
-        one_block = jax.checkpoint(
-            one_block, policy=_REMAT_POLICIES[cfg.remat_policy],
-            prevent_cse=False)
-
-    def stage_fn(stage_params, x):
-        y, _ = jax.lax.scan(one_block, x, stage_params,
-                            unroll=min(cfg.scan_unroll, cfg.num_blocks // S))
-        return y
-
-    def pipeline_body(stage_params, x):
-        # per-device view: stage_params = this stage's (L/S, ...) tree,
-        # x = this dp-shard's (B_loc, N, D) activations (replicated over pp)
-        s = jax.lax.axis_index("pp")
-        b_loc = x.shape[0]
-        mbs = x.reshape(M, b_loc // M, *x.shape[1:])
-        perm = [(i, (i + 1) % S) for i in range(S)]
-
-        def tick(buf, t):
-            inj = jax.lax.dynamic_index_in_dim(
-                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            x_in = jnp.where(s == 0, inj, buf)
-            y = stage_fn(stage_params, x_in)
-            y_out = jnp.where(s == S - 1, y, jnp.zeros_like(y))
-            if S > 1:
-                # the final tick's carry is never read — skip its ICI hop
-                # (cond predicate is uniform across devices, so the
-                # collective stays SPMD-legal; cf. ring attention's
-                # "exactly sp-1 rotations")
-                buf = jax.lax.cond(
-                    t < M + S - 2,
-                    lambda v: jax.lax.ppermute(v, "pp", perm),
-                    lambda v: v, y)
+    def make_one_block(det: bool, collect_aux: bool):
+        def one_block(carry, xs):
+            layer_params, key = xs
+            if layer_specs is not None and mesh.shape["fsdp"] > 1:
+                # ZeRO-3 inside the pipeline: gather this block's shards over
+                # "fsdp" just-in-time (under remat this sits inside the
+                # checkpointed region, so backward re-gathers rather than
+                # holding gathered weights live; the gather's transpose
+                # reduce-scatters the weight cotangents onto the shards).
+                # NOTE specs lead the tree.map: P is a tuple subclass, so it
+                # must be the is_leaf-guarded first tree
+                layer_params = jax.tree.map(
+                    lambda s, x: _gather_over(x, s, "fsdp"),
+                    layer_specs, layer_params, is_leaf=is_spec)
+            rngs = ({"dropout": key}
+                    if (not det) and has_block_dropout else None)
+            if collect_aux:
+                y, cols = block.apply({"params": layer_params}, carry, det,
+                                      rngs=rngs, mutable=["intermediates"])
+                moe_cols = cols["intermediates"]["moe"]
+                # sow stores a tuple of sown values (one per call)
+                aux = (moe_cols["moe_frac_tokens"][0],
+                       moe_cols["moe_mean_prob"][0])
             else:
-                buf = y
-            return buf, y_out
+                y = block.apply({"params": layer_params}, carry, det,
+                                rngs=rngs)
+                aux = None
+            return y, aux
+        if cfg.grad_ckpt:
+            one_block = jax.checkpoint(
+                one_block, policy=_REMAT_POLICIES[cfg.remat_policy],
+                prevent_cse=False)
+        return one_block
 
-        _, ys = jax.lax.scan(tick, jnp.zeros_like(mbs[0]),
-                             jnp.arange(M + S - 1))
-        outs = ys[S - 1:S - 1 + M]          # microbatch i at tick S-1+i
-        outs = jax.lax.psum(outs, "pp")     # one nonzero contributor
-        return outs.reshape(b_loc, *x.shape[1:])
+    def make_pipeline_body(det: bool, collect_aux: bool):
+        one_block = make_one_block(det, collect_aux)
+
+        def stage_fn(stage_params, x, tick_key):
+            # per-layer dropout keys: the tick key folded with the GLOBAL
+            # layer index (stage offset + local index), so every (microbatch,
+            # layer) pair draws an independent mask stream
+            s = jax.lax.axis_index("pp")
+            layer_keys = jax.vmap(
+                lambda i: jax.random.fold_in(tick_key, s * Lps + i)
+            )(jnp.arange(Lps))
+            y, aux = jax.lax.scan(one_block, x, (stage_params, layer_keys),
+                                  unroll=min(cfg.scan_unroll, Lps))
+            return y, aux  # aux: (frac (Lps, E), prob (Lps, E)) or None
+
+        def pipeline_body(stage_params, key_data, x):
+            # per-device view: stage_params = this stage's (Lps, ...) tree,
+            # x = this dp-shard's (B_loc, N, D) activations (replicated over
+            # pp), key_data = the step rng's raw key data (replicated)
+            s = jax.lax.axis_index("pp")
+            # distinct dropout streams per data shard (dp x fsdp x ep)
+            shard_idx = (
+                (jax.lax.axis_index("dp") * mesh.shape["fsdp"]
+                 + jax.lax.axis_index("fsdp")) * mesh.shape["ep"]
+                + jax.lax.axis_index("ep"))
+            base_key = jax.random.fold_in(
+                jax.random.wrap_key_data(key_data), shard_idx)
+            b_loc = x.shape[0]
+            mbs = x.reshape(M, b_loc // M, *x.shape[1:])
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                buf, acc_f, acc_p = carry
+                inj = jax.lax.dynamic_index_in_dim(
+                    mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                x_in = jnp.where(s == 0, inj, buf)
+                y, aux = stage_fn(stage_params, x_in,
+                                  jax.random.fold_in(base_key, t))
+                if collect_aux:
+                    # bubble ticks (t-s outside [0, M)) computed garbage:
+                    # their aux ingredients must not pollute the batch means
+                    valid = jnp.logical_and(t >= s, t - s < M)
+                    acc_f = acc_f + jnp.where(valid, aux[0], 0.0)
+                    acc_p = acc_p + jnp.where(valid, aux[1], 0.0)
+                y_out = jnp.where(s == S - 1, y, jnp.zeros_like(y))
+                if S > 1:
+                    # the final tick's carry is never read — skip its ICI hop
+                    # (cond predicate is uniform across devices, so the
+                    # collective stays SPMD-legal; cf. ring attention's
+                    # "exactly sp-1 rotations")
+                    buf = jax.lax.cond(
+                        t < M + S - 2,
+                        lambda v: jax.lax.ppermute(v, "pp", perm),
+                        lambda v: v, y)
+                else:
+                    buf = y
+                return (buf, acc_f, acc_p), y_out
+
+            acc0 = (jnp.zeros((Lps, cfg.moe_experts), jnp.float32),) * 2 \
+                if collect_aux else (jnp.float32(0.0),) * 2
+            (_, acc_f, acc_p), ys = jax.lax.scan(
+                tick, (jnp.zeros_like(mbs[0]), *acc0),
+                jnp.arange(M + S - 1))
+            outs = ys[S - 1:S - 1 + M]          # microbatch i at tick S-1+i
+            outs = jax.lax.psum(outs, "pp")     # one nonzero contributor
+            outs = outs.reshape(b_loc, *x.shape[1:])
+            if not collect_aux:
+                return outs, jnp.float32(0.0)
+            # per-layer means over microbatches (equal sizes) and data
+            # shards: frac/prob are linear in the tokens, so these means
+            # equal the scan path's full-batch means exactly
+            frac = jax.lax.pmean(acc_f / M, ("dp", "fsdp", "ep"))
+            prob = jax.lax.pmean(acc_p / M, ("dp", "fsdp", "ep"))
+            # nonlinear Switch product only AFTER the means; sum this
+            # stage's layers, then all stages' (each stage contributes its
+            # own Lps rows exactly once)
+            aux = cfg.moe_experts * jnp.sum(frac * prob)
+            aux = jax.lax.psum(aux, "pp") / cfg.num_blocks
+            return outs, aux
+
+        return pipeline_body
 
     act_spec = P(BATCH_AXES, None, None)
 
@@ -158,30 +236,51 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
 
     dtype = model.dtype
 
-    def forward(params, images, deterministic: bool = True):
-        del deterministic  # pp excludes dropout (config.validate), so the
-        # deterministic and non-deterministic paths coincide
+    def forward(params, images, det: bool = True, rng=None,
+                with_aux: bool = False):
         p = params["params"]
         x = PatchEmbed(
             patch_size=cfg.patch_size, embed_dim=cfg.embed_dim, dtype=dtype,
         ).apply({"params": p["patch_embed"]}, images.astype(dtype))
         x = x + p["pos_embed"].astype(dtype)
+        any_dropout = max(cfg.pos_dropout, cfg.att_dropout,
+                          cfg.mlp_dropout) > 0
+        if not det and any_dropout:
+            # match the scan path's failure mode: flax raises on a missing
+            # "dropout" rng rather than silently training deterministically
+            assert rng is not None, (
+                "non-deterministic pp forward with dropout configured "
+                "needs an rng")
+        use_dropout = (not det) and any_dropout
+        if use_dropout and cfg.pos_dropout > 0:
+            # position dropout runs OUTSIDE the shard_map (plain GSPMD);
+            # the module keeps pos-dropout semantics identical to the
+            # scan path's nn.Dropout site (vit.py)
+            x = nn.Dropout(rate=cfg.pos_dropout).apply(
+                {}, x, deterministic=False,
+                rngs={"dropout": jax.random.fold_in(rng, 0x706F5D)})
+
+        if rng is None:  # the body's key input must always be an array
+            rng = jax.random.key(0)
+        pipeline_body = make_pipeline_body(not use_dropout, with_aux)
 
         stacked = p["blocks"]
         in_specs = (block_specs if block_specs is not None
                     else stacked_specs(stacked))
         run = jax.shard_map(
             pipeline_body, mesh=mesh,
-            in_specs=(in_specs, act_spec), out_specs=act_spec,
+            in_specs=(in_specs, P(), act_spec),
+            out_specs=(act_spec, P()),
             check_vma=False)
-        x = run(stacked, x)
+        x, aux = run(stacked, jax.random.key_data(rng), x)
 
         x = nn.LayerNorm(
             epsilon=1e-6, dtype=dtype, param_dtype=jnp.float32,
         ).apply({"params": p["norm"]}, x)
         x = jnp.mean(x, axis=1)
-        return nn.Dense(
+        logits = nn.Dense(
             cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
         ).apply({"params": p["head"]}, x)
+        return (logits, aux) if with_aux else logits
 
     return forward

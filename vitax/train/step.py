@@ -41,21 +41,53 @@ def _make_logits_anchor(mesh: Mesh):
     return lambda logits: jax.lax.with_sharding_constraint(logits, sharding)
 
 
+def _select_by_name(cols, name: str):
+    """Leaves of the 'intermediates' collection whose path contains `name` —
+    sown values are selected BY NAME so any future sow (e.g. a debug metric)
+    cannot silently join the training objective (ADVICE r3)."""
+    return [leaf for path, leaf in jax.tree_util.tree_leaves_with_path(cols)
+            if any(getattr(k, "key", None) == name for k in path)]
+
+
+def aux_from_frac_prob(fracs, probs, cfg: Config):
+    """Switch load-balance loss from the sown per-block (E,) ingredients:
+    mean over blocks of E * sum_e(frac_e * prob_e). Works on stacked
+    (L, ..., E) leaves (the scan path) and per-block lists (unrolled /
+    pipeline paths) alike — the leading axes all reduce into the sum, and
+    the division by num_blocks restores the per-block mean."""
+    assert fracs and len(fracs) == len(probs), (len(fracs), len(probs))
+    total = sum(jnp.sum(f * p) for f, p in zip(fracs, probs))
+    return cfg.moe_experts * total / cfg.num_blocks
+
+
 def _forward_fn(cfg: Config, model, mesh: Mesh, state_specs=None):
-    """The deterministic forward: model.apply, or the GPipe pipeline over the
-    "pp" mesh axis when --pp_size > 1 (vitax/parallel/pipeline.py — same
-    param tree, different block application). Dropout under pp is excluded
-    by config.validate, so the dropout branch never routes around this.
-    The block-param specs (P("pp", ...) + optional "fsdp" dims) come from
-    the state spec tree so the pipeline's just-in-time ZeRO-3 gathers match
-    the actual layout."""
+    """Unified forward: (params, images, det=True, rng=None, with_aux=False)
+    -> logits, or (logits, moe_aux) when with_aux.
+
+    model.apply, or the GPipe pipeline over the "pp" mesh axis when
+    --pp_size > 1 (vitax/parallel/pipeline.py — same param tree, different
+    block application; dropout keys and the MoE aux ingredients are threaded
+    through the pipeline body). The block-param specs (P("pp", ...) +
+    optional "fsdp" dims) come from the state spec tree so the pipeline's
+    just-in-time ZeRO-3 gathers match the actual layout."""
     if getattr(cfg, "pp_size", 1) > 1 and mesh.shape.get("pp", 1) > 1:
         from vitax.parallel.pipeline import make_pp_forward
         block_specs = None
         if state_specs is not None:
             block_specs = state_specs.params["params"]["blocks"]
         return make_pp_forward(cfg, model, mesh, block_specs=block_specs)
-    return lambda params, images, det=True: model.apply(params, images, det)
+
+    def forward(params, images, det=True, rng=None, with_aux=False):
+        rngs = {"dropout": rng} if (rng is not None and not det) else None
+        if not with_aux:
+            return model.apply(params, images, det, rngs=rngs)
+        logits, cols = model.apply(params, images, det, rngs=rngs,
+                                   mutable=["intermediates"])
+        fracs = _select_by_name(cols, "moe_frac_tokens")
+        probs = _select_by_name(cols, "moe_mean_prob")
+        return logits, aux_from_frac_prob(fracs, probs, cfg)
+
+    return forward
 
 
 def prepare_images(images: jax.Array) -> jax.Array:
@@ -97,25 +129,15 @@ def make_train_step(
 
     def loss_fn(params, batch, rng):
         images = prepare_images(batch["image"])
+        det = not dropout
+        r = rng if dropout else None
         if moe:
-            # collect the per-block MoE load-balance losses sown into the
-            # "intermediates" collection (vitax/models/moe.py); mean over
-            # blocks, weighted into the objective (Switch Transformer)
-            rngs = {"dropout": rng} if dropout else None
-            logits, cols = model.apply(params, images, not dropout,
-                                       rngs=rngs, mutable=["intermediates"])
-            # select the moe_aux_loss sows BY NAME: any future sow into
-            # "intermediates" (e.g. a debug metric) must not silently join
-            # the training objective (ADVICE r3)
-            aux_leaves = [
-                leaf for path, leaf in jax.tree_util.tree_leaves_with_path(cols)
-                if any(getattr(k, "key", None) == "moe_aux_loss" for k in path)]
-            assert aux_leaves, "moe_experts > 0 but no moe_aux_loss was sown"
-            aux = sum(jnp.sum(a) for a in aux_leaves) / cfg.num_blocks
-        elif dropout:
-            logits = model.apply(params, images, False, rngs={"dropout": rng})
+            # the per-block MoE load-balance ingredients ride the
+            # "intermediates" collection (vitax/models/moe.py); weighted
+            # into the objective (Switch Transformer)
+            logits, aux = forward(params, images, det, rng=r, with_aux=True)
         else:
-            logits = forward(params, images, True)
+            logits = forward(params, images, det, rng=r)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             anchor_logits(logits), batch["label"]).mean()
         if moe:
