@@ -73,6 +73,7 @@ class Config:
     sp_impl: str = "ring"               # ring (ppermute K/V rotation) | ulysses (all-to-all head<->token)
     pp_size: int = 1                    # pipeline stages (GPipe over the stacked layer axis; composes with dp and fsdp)
     pp_microbatches: int = 0            # GPipe microbatches per step (0 = pp_size; bubble = (S-1)/(M+S-1))
+    pp_schedule: str = "gpipe"          # gpipe (autodiff backward, O(M) live acts) | 1f1b (interleaved fwd/bwd, O(S) live acts — enables large M)
     ep_size: int = 1                    # expert-parallel axis (also carries batch; experts sharded across it)
     moe_experts: int = 0                # 0 = dense reference MLP; >0 = top-1 MoE in every block
     moe_capacity_factor: float = 1.25   # static expert capacity C = ceil(cf * tokens / experts)
@@ -129,11 +130,19 @@ class Config:
             assert self.num_blocks % self.pp_size == 0, (
                 f"--num_blocks {self.num_blocks} not divisible by --pp_size {self.pp_size}")
             assert self.pp_microbatches >= 0
+            assert self.pp_schedule in ("gpipe", "1f1b"), self.pp_schedule
             if self.moe_experts > 0:
                 assert self.ep_size == 1, (
                     "--moe_experts under --pp_size > 1 needs experts "
                     "replicated (--ep_size 1): expert sharding inside the "
                     "manual pipeline body would need its own all-to-alls")
+            if self.pp_schedule == "1f1b":
+                assert max(self.pos_dropout, self.att_dropout,
+                           self.mlp_dropout) == 0.0 and self.moe_experts == 0, (
+                    "--pp_schedule 1f1b v1 is dense/deterministic only "
+                    "(dropout and MoE ride the gpipe schedule); the "
+                    "interleaved backward always recomputes the stage "
+                    "forward (none_saveable semantics)")
         if self.ep_size > 1:
             assert self.moe_experts > 0, "--ep_size > 1 needs --moe_experts"
             assert self.moe_experts % self.ep_size == 0, (
@@ -198,6 +207,8 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["ring", "ulysses"])
     ext.add_argument("--pp_size", type=int, default=1)
     ext.add_argument("--pp_microbatches", type=int, default=0)
+    ext.add_argument("--pp_schedule", type=str, default="gpipe",
+                     choices=["gpipe", "1f1b"])
     ext.add_argument("--ep_size", type=int, default=1)
     ext.add_argument("--moe_experts", type=int, default=0)
     ext.add_argument("--moe_capacity_factor", type=float, default=1.25)

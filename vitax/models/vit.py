@@ -398,6 +398,29 @@ class VisionTransformer(nn.Module):
         return logits
 
 
+def apply_embed(p, images, *, patch_size: int, embed_dim: int, dtype):
+    """Functional PatchEmbed + pos-embed application against an existing
+    param tree — the pipeline paths (vitax/parallel/pipeline*.py) run the
+    embed outside their shard_map and must match VisionTransformer.__call__
+    exactly; keep in sync with the @nn.compact body above."""
+    x = PatchEmbed(
+        patch_size=patch_size, embed_dim=embed_dim, dtype=dtype,
+    ).apply({"params": p["patch_embed"]}, images.astype(dtype))
+    return x + p["pos_embed"].astype(dtype)
+
+
+def apply_tail(p, x, *, num_classes: int, dtype):
+    """Functional final-LayerNorm + mean-pool + head against an existing
+    param tree (same keep-in-sync contract as apply_embed)."""
+    x = nn.LayerNorm(
+        epsilon=1e-6, dtype=dtype, param_dtype=jnp.float32,
+    ).apply({"params": p["norm"]}, x)
+    x = jnp.mean(x, axis=1)
+    return nn.Dense(
+        num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+    ).apply({"params": p["head"]}, x)
+
+
 def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
                 token_sharding=None, moe_dispatch_sharding=None) -> VisionTransformer:
     """Construct the model from config (reference build_fsdp_vit_model parity,

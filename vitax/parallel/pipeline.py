@@ -78,7 +78,7 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     """
     import flax.linen as nn
 
-    from vitax.models.vit import _REMAT_POLICIES, Block, PatchEmbed
+    from vitax.models.vit import _REMAT_POLICIES, Block
 
     S = mesh.shape["pp"]
     M = cfg.pp_microbatches or S
@@ -238,11 +238,10 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
 
     def forward(params, images, det: bool = True, rng=None,
                 with_aux: bool = False):
+        from vitax.models.vit import apply_embed, apply_tail
         p = params["params"]
-        x = PatchEmbed(
-            patch_size=cfg.patch_size, embed_dim=cfg.embed_dim, dtype=dtype,
-        ).apply({"params": p["patch_embed"]}, images.astype(dtype))
-        x = x + p["pos_embed"].astype(dtype)
+        x = apply_embed(p, images, patch_size=cfg.patch_size,
+                        embed_dim=cfg.embed_dim, dtype=dtype)
         any_dropout = max(cfg.pos_dropout, cfg.att_dropout,
                           cfg.mlp_dropout) > 0
         if not det and any_dropout:
@@ -274,13 +273,7 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
             check_vma=False)
         x, aux = run(stacked, jax.random.key_data(rng), x)
 
-        x = nn.LayerNorm(
-            epsilon=1e-6, dtype=dtype, param_dtype=jnp.float32,
-        ).apply({"params": p["norm"]}, x)
-        x = jnp.mean(x, axis=1)
-        logits = nn.Dense(
-            cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
-        ).apply({"params": p["head"]}, x)
+        logits = apply_tail(p, x, num_classes=cfg.num_classes, dtype=dtype)
         return (logits, aux) if with_aux else logits
 
     return forward

@@ -148,13 +148,26 @@ def make_train_step(
     gathered_shardings = (
         shardings_of(mesh, gather_over_fsdp(state_specs.params)) if zero2 else None)
 
+    use_1f1b = (getattr(cfg, "pp_schedule", "gpipe") == "1f1b"
+                and cfg.pp_size > 1 and mesh.shape.get("pp", 1) > 1)
+    if use_1f1b:
+        # the interleaved schedule computes the loss INSIDE the pipelined
+        # region (per microbatch, at the last stage) and hand-assembles the
+        # grads — it replaces value_and_grad wholesale
+        from vitax.parallel.pipeline_1f1b import make_1f1b_value_and_grad
+        vag_1f1b = make_1f1b_value_and_grad(cfg, model, mesh, state_specs)
+
     def train_step(state: TrainState, batch, rng):
         step_rng = jax.random.fold_in(rng, state.step)
         if zero2:
             params = jax.lax.with_sharding_constraint(state.params, gathered_shardings)
         else:
             params = state.params
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, step_rng)
+        if use_1f1b:
+            loss, grads = vag_1f1b(params, prepare_images(batch["image"]),
+                                   batch["label"])
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, step_rng)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
