@@ -80,10 +80,12 @@ def test_ring_kernel_block_matches_dense(devices8):
 
 
 def test_ring_issues_exactly_sp_minus_one_permutes(devices8):
-    """The K/V rotation must run sp-1 times per tensor (the last block needs no
-    next-block fetch) and be visible as individually schedulable (unrolled)
-    collective-permutes — VERDICT round-1 item 3. sp=4 here: expect
-    2*(sp-1) = 6 permutes in the forward HLO, not 2*sp = 8."""
+    """The K/V rotation must run exactly sp-1 times (the last block needs no
+    next-block fetch), as ONE collective per ring step: K and V ride a single
+    stacked buffer because XLA does not reliably merge distinct ppermutes
+    into one transfer (same lesson as ulysses.py's stacked all-to-all;
+    VERDICT r3 weak #6). sp=4 here: expect sp-1 = 3 permutes in the forward
+    HLO — not 2*(sp-1) = 6 (separate K and V hops), not 2*sp = 8."""
     cfg = sp_cfg()
     mesh = build_mesh(cfg)  # dp1 x fsdp2 x tp1 x sp4
     ring = make_ring_attention(mesh)
@@ -91,7 +93,8 @@ def test_ring_issues_exactly_sp_minus_one_permutes(devices8):
     q = jnp.ones(shape, jnp.float32)
     hlo = jax.jit(ring).lower(q, q, q).as_text()
     n_permutes = hlo.count("collective_permute")
-    assert n_permutes == 6, f"expected 6 collective_permutes (2 tensors x sp-1), got {n_permutes}"
+    assert n_permutes == 3, (
+        f"expected 3 collective_permutes (stacked K/V x sp-1), got {n_permutes}")
 
 
 def test_sequence_parallel_train_step_equivalence(devices8):

@@ -16,7 +16,9 @@ Design (TPU-first):
   product, so XLA's latency-hiding scheduler turns each collective-permute
   into a start/done pair overlapped with the MXU work — double buffering,
   scheduled by the compiler.
-- Exactly sp-1 rotations per tensor: the last block computes without a
+- Exactly sp-1 rotations TOTAL: K and V ride one stacked buffer so each ring
+  step is a single collective-permute (XLA does not reliably merge distinct
+  ppermutes — the ulysses.py lesson), and the last block computes without a
   permute (there is no next block to fetch).
 - The local block product runs on the Pallas kernels on TPU, selected by the
   same policy cascade as full-sequence dispatch
@@ -83,19 +85,22 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
     sp = jax.lax.axis_size(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    k_blk, v_blk = k, v
+    # K and V ride ONE stacked (2, B, N_loc, H, Dh) buffer so each ring step
+    # issues a SINGLE collective-permute — XLA does not reliably merge
+    # distinct ppermutes into one transfer (the same lesson as ulysses.py's
+    # stacked all-to-all), and two hops per step means two latencies to hide
+    kv_blk = jnp.stack([k, v])
     o = lse = None
     for step in range(sp):
         last = step == sp - 1
         if not last:
             # issue the rotation BEFORE the block product — no data dependence,
             # so the collective-permute overlaps the MXU work (double buffer)
-            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-        o_blk, lse_blk = block_fn(q, k_blk, v_blk, scale)
+            kv_nxt = jax.lax.ppermute(kv_blk, axis_name, perm)
+        o_blk, lse_blk = block_fn(q, kv_blk[0], kv_blk[1], scale)
         o, lse = (o_blk, lse_blk) if o is None else _merge(o, lse, o_blk, lse_blk)
         if not last:
-            k_blk, v_blk = k_nxt, v_nxt
+            kv_blk = kv_nxt
     return o.astype(q.dtype)
 
 
