@@ -298,10 +298,17 @@ def default_scan_unroll(preset: str) -> int:
     return 1
 
 
-def resolve_scan_knobs(scan_blocks, scan_unroll: int, preset: str):
+def resolve_scan_knobs(scan_blocks, scan_unroll: int, preset: str,
+                       remat_window: int = 0):
     """Resolve the (scan_blocks, scan_unroll) pair from CLI values + per-preset
     defaults. Shared with tools/profile_step.py so traces explain exactly the
-    configs the bench measures."""
+    configs the bench measures. remat_window > 1 (the windowed-remat
+    experiment) forces the scan path — even for presets whose measured
+    default is unrolled (l14)."""
+    if remat_window > 1:
+        assert scan_blocks is not False, (
+            "--remat_window needs the scan path (drop --no_scan_blocks)")
+        scan_blocks = True
     assert not (scan_blocks is False and scan_unroll), (
         "--no_scan_blocks contradicts --scan_unroll (unroll is a scan knob)")
     if scan_blocks is None:
@@ -571,10 +578,11 @@ def bench_train(args, metric_stub: str) -> None:
     if args.remat_policy is None:
         args.remat_policy = default_remat_policy(args.preset)
     args.scan_blocks, args.scan_unroll = resolve_scan_knobs(
-        args.scan_blocks, args.scan_unroll, args.preset)
+        args.scan_blocks, args.scan_unroll, args.preset,
+        remat_window=args.remat_window)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
-                 scan_unroll=args.scan_unroll,
+                 scan_unroll=args.scan_unroll, remat_window=args.remat_window,
                  use_flash_attention=args.use_flash_attention, **kw).validate()
 
     mesh = build_mesh(cfg)
@@ -617,12 +625,16 @@ def bench_train(args, metric_stub: str) -> None:
 
     base_entry = read_baseline().get(args.preset, {})
     knobs = ("batch_size", "remat_policy", "scan_blocks", "scan_unroll",
-             "grad_ckpt", "use_flash_attention")
+             "remat_window", "grad_ckpt", "use_flash_attention")
     # compare only like-for-like: a knob change (e.g. the scan->unrolled
-    # default flip) must not masquerade as a same-config speedup — entries
-    # missing a knob (older files) count as matching for that knob
-    same_config = all(base_entry.get(k, getattr(cfg, k)) == getattr(cfg, k)
-                      for k in knobs)
+    # default flip) must not masquerade as a same-config speedup. Entries
+    # written before a knob existed compare at the Config FIELD DEFAULT —
+    # that is the value they were actually measured at — never at the
+    # current run's value (which would make every experiment "match")
+    field_defaults = Config()
+    same_config = all(
+        base_entry.get(k, getattr(field_defaults, k, None)) == getattr(cfg, k)
+        for k in knobs)
     base = base_entry.get("images_per_sec_chip") if same_config else None
     # None (JSON null) whenever there is nothing comparable: differing knob
     # sets AND missing/never-measured baselines must be visible, not
@@ -641,6 +653,7 @@ def bench_train(args, metric_stub: str) -> None:
             # masquerade as the default-config baseline in the JSON
             "scan_blocks": cfg.scan_blocks,
             "scan_unroll": cfg.scan_unroll,
+            "remat_window": cfg.remat_window,
             "grad_ckpt": cfg.grad_ckpt,
             "use_flash_attention": cfg.use_flash_attention,
         })
@@ -677,6 +690,10 @@ def main():
     p.add_argument("--scan_unroll", type=int, default=0,
                    help="blocks per scan step (0 = preset default); keeps the "
                         "stacked param tree, frees cross-block fusion")
+    p.add_argument("--remat_window", type=int, default=0,
+                   help=">1: remat around groups of this many blocks "
+                        "(functional scan; residuals dus-stack once per "
+                        "group — the wgrad stacking experiment)")
     p.add_argument("--no_flash_attention", action="store_false",
                    dest="use_flash_attention")
     p.add_argument("--steps", type=int, default=30)

@@ -166,3 +166,44 @@ def test_mean_pool_not_cls():
     cfg = tiny_cfg()
     _, params = init_params(cfg)
     assert params["params"]["pos_embed"].shape[1] == (32 // 16) ** 2
+
+
+def test_windowed_remat_matches_scan_path(devices8):
+    """--remat_window w: the functional group-remat scan (make_windowed_forward)
+    consumes the SAME stacked param tree and must reproduce the per-block
+    scan path exactly — forward, grads, and a short training trajectory (the
+    wgrad dus-stacking experiment must not change the math)."""
+    import numpy as np
+    from tests.test_train_smoke import run_steps
+    from vitax.config import Config
+    from vitax.models.vit import make_windowed_forward
+
+    kw = dict(image_size=32, patch_size=8, embed_dim=32, num_heads=4,
+              num_blocks=4, num_classes=4, batch_size=16, dtype="float32",
+              fsdp_size=-1, warmup_steps=0, grad_ckpt=True)
+    cfg_w = Config(remat_window=2, **kw).validate()
+    cfg_ref = Config(**kw).validate()
+
+    model = build_model(cfg_ref)
+    x = jax.random.normal(jax.random.key(1),
+                          (16, 32, 32, 3), jnp.float32)
+    params = jax.jit(lambda k: model.init(k, x[:1], True))(jax.random.key(0))
+    fwd_w = make_windowed_forward(cfg_w, model)
+
+    ref = model.apply(params, x, True)
+    got = jax.jit(fwd_w)(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(lambda p: jnp.sum(model.apply(p, x, True) ** 2))(params)
+    g_w = jax.grad(lambda p: jnp.sum(fwd_w(p, x) ** 2))(params)
+    for (ka, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_ref)[0],
+            jax.tree_util.tree_flatten_with_path(g_w)[0]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(ka)}")
+
+    _, losses_w = run_steps(cfg_w, n_steps=3)
+    _, losses_ref = run_steps(cfg_ref, n_steps=3)
+    np.testing.assert_allclose(losses_w, losses_ref, rtol=2e-4)

@@ -31,6 +31,7 @@ def main():
     p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
                    default=None)
     p.add_argument("--scan_unroll", type=int, default=0)
+    p.add_argument("--remat_window", type=int, default=0)
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -58,10 +59,11 @@ def main():
     remat = args.remat_policy or default_remat_policy(args.preset)
     from bench import resolve_scan_knobs
     args.scan_blocks, args.scan_unroll = resolve_scan_knobs(
-        args.scan_blocks, args.scan_unroll, args.preset)
+        args.scan_blocks, args.scan_unroll, args.preset,
+        remat_window=args.remat_window)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=remat,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,
-                 **kw).validate()
+                 remat_window=args.remat_window, **kw).validate()
 
     mesh = build_mesh(cfg)
     model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh))

@@ -84,6 +84,9 @@ class Config:
     #   (the scan's per-block dus-stacking constrains wgrad fusion layouts —
     #   measured l14/v5e: full unroll +29% step throughput; partial unroll
     #   keeps the stacked param tree and O(L/unroll) compile)
+    remat_window: int = 0               # >1: remat around GROUPS of this many blocks (functional scan;
+    #   saved residuals dus-stack once per group instead of per block — the
+    #   wgrad-fusion experiment for the measured 85-100 TF/s stacking ceiling)
     device_normalize: bool = True       # ship uint8 batches; normalize on-device (4x less host->device traffic)
     # none_saveable = the reference's checkpoint_module semantics (recompute
     # everything) and the least HBM — the right default for the 10B+ flagship.
@@ -116,6 +119,20 @@ class Config:
             f"unknown sp_impl {self.sp_impl!r} (expected 'ring' or 'ulysses')")
         assert self.scan_unroll >= 1, (
             f"--scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.remat_window > 1:
+            assert self.scan_blocks and self.grad_ckpt, (
+                "--remat_window needs the scanned stacked tree and remat on")
+            assert self.num_blocks % self.remat_window == 0, (
+                f"--num_blocks {self.num_blocks} not divisible by "
+                f"--remat_window {self.remat_window}")
+            assert self.scan_unroll == 1, (
+                "--remat_window subsumes --scan_unroll (the window IS the "
+                "unrolled group); drop one of the two")
+            assert self.pp_size == 1 and self.moe_experts == 0 and (
+                max(self.pos_dropout, self.att_dropout,
+                    self.mlp_dropout) == 0.0), (
+                "--remat_window is the dense/deterministic wgrad experiment "
+                "(v1): no pp, MoE, or dropout")
         if self.pp_size > 1:
             assert self.scan_blocks, "--pp_size needs the stacked block tree (drop --no_scan_blocks)"
             assert self.reshard_after_forward or self.fsdp_size == 1, (
@@ -216,6 +233,7 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--moe_aux_weight", type=float, default=0.01)
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
     ext.add_argument("--scan_unroll", type=int, default=1)
+    ext.add_argument("--remat_window", type=int, default=0)
     ext.add_argument("--host_normalize", action="store_false", dest="device_normalize")
     ext.add_argument("--remat_policy", type=str, default=Config.remat_policy,
                      choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])

@@ -421,6 +421,52 @@ def apply_tail(p, x, *, num_classes: int, dtype):
     ).apply({"params": p["head"]}, x)
 
 
+def make_windowed_forward(cfg: Config, model: "VisionTransformer"):
+    """Functional scan forward with remat around GROUPS of --remat_window
+    blocks instead of per block.
+
+    The wgrad experiment for the profiled l14 ceiling (BASELINE.md): the
+    per-block scan's saved residuals are written into (L, ...) stacked
+    buffers by dynamic-update-slice each iteration, and the backward wgrad
+    fusions co-writing those buffers run at 85-100 TF/s vs 164-182
+    unconstrained. A group of w blocks saves its residuals ONCE per group
+    (L/w stacking events) and gives XLA a w-block window to lay out wgrad
+    fusions freely — like --scan_unroll, plus group-level checkpoint
+    placement. Consumes the SAME stacked (L, ...) param tree (reshaped in
+    the compute graph only — init and checkpoints are unchanged).
+    Dense/deterministic v1 (config.validate)."""
+    w = cfg.remat_window
+    groups = cfg.num_blocks // w
+    block = Block(**model.block_kwargs())  # keeps the activation anchors
+    policy = _REMAT_POLICIES[cfg.remat_policy]
+    dtype = model.dtype
+
+    def forward(params, images, det: bool = True, rng=None,
+                with_aux: bool = False):
+        del rng
+        assert det and not with_aux, (
+            "windowed forward is dense/deterministic (config.validate)")
+        p = params["params"]
+        x = apply_embed(p, images, patch_size=cfg.patch_size,
+                        embed_dim=cfg.embed_dim, dtype=dtype)
+        if model.token_sharding is not None:
+            x = jax.lax.with_sharding_constraint(x, model.token_sharding)
+        grouped = jax.tree.map(
+            lambda l: l.reshape(groups, w, *l.shape[1:]), p["blocks"])
+
+        def apply_group(carry, gparams):
+            for i in range(w):
+                layer = jax.tree.map(lambda g: g[i], gparams)
+                carry = block.apply({"params": layer}, carry, True)
+            return carry
+
+        body = jax.checkpoint(apply_group, policy=policy, prevent_cse=False)
+        x, _ = jax.lax.scan(lambda c, gp: (body(c, gp), None), x, grouped)
+        return apply_tail(p, x, num_classes=cfg.num_classes, dtype=dtype)
+
+    return forward
+
+
 def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
                 token_sharding=None, moe_dispatch_sharding=None) -> VisionTransformer:
     """Construct the model from config (reference build_fsdp_vit_model parity,

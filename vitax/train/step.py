@@ -76,6 +76,11 @@ def _forward_fn(cfg: Config, model, mesh: Mesh, state_specs=None):
         if state_specs is not None:
             block_specs = state_specs.params["params"]["blocks"]
         return make_pp_forward(cfg, model, mesh, block_specs=block_specs)
+    if getattr(cfg, "remat_window", 0) > 1:
+        # group-remat functional scan (the wgrad dus-stacking experiment;
+        # same param tree, different checkpoint placement)
+        from vitax.models.vit import make_windowed_forward
+        return make_windowed_forward(cfg, model)
 
     def forward(params, images, det=True, rng=None, with_aux=False):
         rngs = {"dropout": rng} if (rng is not None and not det) else None
