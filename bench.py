@@ -280,49 +280,102 @@ def train_presets(n_dev: int) -> dict:
     }
 
 
-def default_scan_blocks(preset: str) -> bool:
-    """Per-preset scan-vs-unrolled default. l14 measured 250.1 img/s/chip
-    fully unrolled vs 194.3 under lax.scan on v5e (batch 32,
-    dots_attn_saveable — the scan's per-block dus-stacking caps wgrad
-    fusions at 85-100 TF/s vs 164+ unconstrained), so the bench default for
-    l14 is the unrolled path. Other presets keep the scan until their
-    ladders are measured (tiny/b16 queued; 10b_slice's HBM frontier was
-    measured under scan and unrolling changes its temp layout)."""
+TUNED_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "TUNED.json")
+
+
+def _tuned(preset: str) -> dict:
+    """Measured per-preset knob winners (tools/apply_ladder.py writes
+    TUNED.json from the chip watcher's ladder results, so defaults track
+    the hardware measurements without a code edit)."""
+    try:
+        with open(TUNED_FILE) as f:
+            return json.load(f).get(preset, {})
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def default_scan_blocks(preset: str, allow_tuned: bool = True) -> bool:
+    """Per-preset scan-vs-unrolled default: the TUNED.json winner when the
+    ladder has been measured; else l14 measured 250.1 img/s/chip fully
+    unrolled vs 194.3 under lax.scan on v5e (batch 32, dots_attn_saveable —
+    the scan's per-block dus-stacking caps wgrad fusions at 85-100 TF/s vs
+    164+ unconstrained), so l14 defaults to the unrolled path and other
+    presets keep the scan. allow_tuned=False pins the pre-TUNED fallback."""
+    t = _tuned(preset) if allow_tuned else {}
+    if "scan_blocks" in t:
+        return bool(t["scan_blocks"])
     return preset != "l14"
 
 
-def default_scan_unroll(preset: str) -> int:
-    """Per-preset scan unroll (only meaningful when the scan path is used).
-    1 until the partial-unroll ladder is measured on hardware — the sweep is
-    queued; set measured winners here and record them in BASELINE.md."""
-    return 1
+def default_scan_unroll(preset: str, allow_tuned: bool = True) -> int:
+    """Per-preset scan unroll (only meaningful when the scan path is used):
+    the TUNED.json winner when measured, else 1."""
+    t = _tuned(preset) if allow_tuned else {}
+    return int(t.get("scan_unroll", 1))
 
 
-def resolve_scan_knobs(scan_blocks, scan_unroll: int, preset: str,
-                       remat_window: int = 0):
-    """Resolve the (scan_blocks, scan_unroll) pair from CLI values + per-preset
-    defaults. Shared with tools/profile_step.py so traces explain exactly the
-    configs the bench measures. remat_window > 1 (the windowed-remat
-    experiment) forces the scan path — even for presets whose measured
-    default is unrolled (l14)."""
+def default_remat_window(preset: str, allow_tuned: bool = True) -> int:
+    """Per-preset remat window (the group-remat wgrad experiment): the
+    TUNED.json winner when measured, else 0 (per-block remat)."""
+    t = _tuned(preset) if allow_tuned else {}
+    return int(t.get("remat_window", 0))
+
+
+def resolve_bench_knobs(scan_blocks, scan_unroll: int, remat_window: int,
+                        remat_policy, preset: str):
+    """Resolve the full (scan_blocks, scan_unroll, remat_window,
+    remat_policy) knob set from CLI values + per-preset defaults. Shared
+    with tools/profile_step.py so traces explain exactly the configs the
+    bench measures.
+
+    ONE rule keeps A/Bs pure: tuned defaults (TUNED.json winners) apply
+    ONLY when NO knob was given explicitly. Any explicit knob pins every
+    other default to its pre-TUNED fallback, so an A/B run differs from
+    the historical reference by exactly the knobs on its command line —
+    never by a default that TUNED flipped since.
+
+    remat_window: -1 = unset; 0 = explicit per-block remat; >1 = the
+    windowed-remat experiment, which forces the scan path even for presets
+    whose measured default is unrolled (l14)."""
+    explicit = (scan_blocks is not None or bool(scan_unroll)
+                or remat_window >= 0 or remat_policy is not None)
+    tuned_ok = not explicit
+    if remat_window < 0:
+        remat_window = default_remat_window(preset, allow_tuned=tuned_ok)
+    if remat_policy is None:
+        remat_policy = default_remat_policy(preset, allow_tuned=tuned_ok)
     if remat_window > 1:
         assert scan_blocks is not False, (
             "--remat_window needs the scan path (drop --no_scan_blocks)")
-        scan_blocks = True
+        assert scan_unroll in (0, 1), (
+            "--remat_window subsumes --scan_unroll (the window IS the "
+            "unrolled group); drop one of the two")
+        # pin the unroll (Config.validate rejects the combination)
+        return True, 1, remat_window, remat_policy
     assert not (scan_blocks is False and scan_unroll), (
         "--no_scan_blocks contradicts --scan_unroll (unroll is a scan knob)")
     if scan_blocks is None:
         # an explicit --scan_unroll is a request for the scan path
-        scan_blocks = True if scan_unroll else default_scan_blocks(preset)
+        scan_blocks = (True if scan_unroll
+                       else default_scan_blocks(preset, allow_tuned=tuned_ok))
     if not scan_unroll:
-        scan_unroll = default_scan_unroll(preset)
-    return scan_blocks, scan_unroll
+        scan_unroll = default_scan_unroll(preset, allow_tuned=tuned_ok)
+    return scan_blocks, scan_unroll, remat_window, remat_policy
 
 
-def default_remat_policy(preset: str) -> str:
-    """Per-preset remat default (measured on v5e l14: dots_attn_saveable 192.9
-    > dots_saveable 190.2 > none_saveable img/s/chip; the 10B flagship keeps
-    none_saveable — minimal HBM residency is what makes it fit)."""
+def default_remat_policy(preset: str, allow_tuned: bool = True) -> str:
+    """Per-preset remat default: the TUNED.json winner's policy when the
+    ladder has been measured (a win under a non-default policy must flip the
+    policy along with the scan knobs); else measured on v5e l14:
+    dots_attn_saveable 192.9 > dots_saveable 190.2 > none_saveable
+    img/s/chip; the 10B flagship keeps none_saveable — minimal HBM residency
+    is what makes it fit. allow_tuned=False pins the pre-TUNED fallback
+    (explicit knob A/Bs must differ from their reference by ONE knob)."""
+    if allow_tuned:
+        tuned = _tuned(preset).get("remat_policy")
+        if tuned:
+            return tuned
     return "none_saveable" if preset.startswith("10b") else "dots_attn_saveable"
 
 
@@ -575,11 +628,10 @@ def bench_train(args, metric_stub: str) -> None:
     kw = train_presets(n_dev)[args.preset]
     if args.batch_size:
         kw["batch_size"] = args.batch_size
-    if args.remat_policy is None:
-        args.remat_policy = default_remat_policy(args.preset)
-    args.scan_blocks, args.scan_unroll = resolve_scan_knobs(
-        args.scan_blocks, args.scan_unroll, args.preset,
-        remat_window=args.remat_window)
+    (args.scan_blocks, args.scan_unroll, args.remat_window,
+     args.remat_policy) = resolve_bench_knobs(
+        args.scan_blocks, args.scan_unroll, args.remat_window,
+        args.remat_policy, args.preset)
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll, remat_window=args.remat_window,
@@ -665,6 +717,14 @@ def bench_train(args, metric_stub: str) -> None:
         "value": round(images_per_sec_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": vs_baseline,
+        # the RESOLVED knob set this number was measured under — ground
+        # truth for tools/apply_ladder.py (reconstructing knobs from CLI
+        # flags drifts once TUNED.json changes the defaults)
+        "knobs": {"batch_size": cfg.batch_size,
+                  "remat_policy": cfg.remat_policy,
+                  "scan_blocks": cfg.scan_blocks,
+                  "scan_unroll": cfg.scan_unroll,
+                  "remat_window": cfg.remat_window},
     })
 
 
@@ -690,10 +750,11 @@ def main():
     p.add_argument("--scan_unroll", type=int, default=0,
                    help="blocks per scan step (0 = preset default); keeps the "
                         "stacked param tree, frees cross-block fusion")
-    p.add_argument("--remat_window", type=int, default=0,
+    p.add_argument("--remat_window", type=int, default=-1,
                    help=">1: remat around groups of this many blocks "
                         "(functional scan; residuals dus-stack once per "
-                        "group — the wgrad stacking experiment)")
+                        "group — the wgrad stacking experiment); 0 = "
+                        "explicit per-block remat; -1 = tuned/preset default")
     p.add_argument("--no_flash_attention", action="store_false",
                    dest="use_flash_attention")
     p.add_argument("--steps", type=int, default=30)

@@ -30,9 +30,12 @@ def test_bench_prints_one_json_line():
     assert r.returncode == 0, r.stderr[-2000:]
     line = r.stdout.strip().splitlines()[-1]
     result = json.loads(line)
-    assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+    # the four contract keys must be present (extra fields — "knobs", and
+    # "error"/"last_measured" on failure paths — are part of the design)
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
     assert result["unit"] == "images/sec/chip"
     assert result["value"] > 0
+    assert result["knobs"]["batch_size"] == 8
 
 
 @pytest.mark.slow
@@ -53,3 +56,94 @@ def test_graft_entry_compiles_single_chip():
         extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
     assert r.returncode == 0, r.stderr[-2000:]
     assert "entry ok" in r.stdout
+
+
+def test_apply_ladder_picks_measured_winners(tmp_path, monkeypatch):
+    """tools/apply_ladder.py closes the measure->tune loop: ladder rows +
+    the stored default-config baseline in, per-preset knob winners out
+    (TUNED.json), which bench.py's default_* functions then consult — the
+    chip watcher can flip defaults to measured winners autonomously.
+    Safety rules under test: never flip away from an UNMEASURED current
+    default; ignore errored/truncated/non-knob rows; small wins below
+    min_gain don't flip; a policy win flips the policy."""
+    import json
+    import importlib
+
+    def knobs(sb, su, rw, policy):
+        return {"scan_blocks": sb, "scan_unroll": su, "remat_window": rw,
+                "remat_policy": policy, "batch_size": 32}
+
+    ladder = tmp_path / "ladder.jsonl"
+    rows = [
+        # l14 code default is the unrolled path: measure it, then beat it
+        {"args": "--preset l14",
+         "result": {"value": 250.0,
+                    "knobs": knobs(False, 1, 0, "dots_attn_saveable")}},
+        {"args": "--preset l14 --remat_window 8",
+         "result": {"value": 280.0,
+                    "knobs": knobs(True, 1, 8, "dots_attn_saveable")}},
+        # b16: alternative beats the measured default by < min_gain -> keep
+        {"args": "--preset b16 --no_scan_blocks",
+         "result": {"value": 100.0,
+                    "knobs": knobs(False, 1, 0, "dots_attn_saveable")}},
+        # 10b_slice: a policy-only win must flip the policy along
+        {"args": "--preset 10b_slice --remat_policy dots_saveable",
+         "result": {"value": 130.0,
+                    "knobs": knobs(True, 1, 0, "dots_saveable")}},
+        # ignored rows: truncated, errored-with-positive-value, non-knob
+        {"args": "--preset l14 --scan_unroll", "result": {"value": 999.0}},
+        {"args": "--preset l14 --remat_window 16",
+         "result": {"value": 999.0, "error": "watchdog killed",
+                    "knobs": knobs(True, 1, 16, "dots_attn_saveable")}},
+        {"args": "--preset tiny --batch_size 8", "result": {"value": 999.0}},
+    ]
+    ladder.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+
+    sys.path.insert(0, str(REPO))
+    sys.path.insert(0, str(os.path.join(REPO, "tools")))
+    apply_ladder = importlib.import_module("apply_ladder")
+    baselines = {
+        # b16's stored row IS its current default (scan path), measured
+        "b16": {"images_per_sec_chip": 99.0, "scan_blocks": True,
+                "scan_unroll": 1, "remat_window": 0,
+                "remat_policy": "dots_attn_saveable"},
+        # 10b_slice default (scan, none_saveable) measured at 116
+        "10b_slice": {"images_per_sec_chip": 116.0, "scan_blocks": True,
+                      "scan_unroll": 1, "remat_window": 0,
+                      "remat_policy": "none_saveable"},
+        # tiny default measured — but tiny has no eligible ladder rows
+        "tiny": {"images_per_sec_chip": 3827.0, "scan_blocks": True,
+                 "scan_unroll": 1, "remat_window": 0,
+                 "remat_policy": "dots_attn_saveable"},
+    }
+    base_file = tmp_path / "BASELINE_MEASURED.json"
+    base_file.write_text(json.dumps(baselines))
+    out = tmp_path / "TUNED.json"
+    monkeypatch.setattr(apply_ladder, "REPO", str(tmp_path))
+    import bench
+    monkeypatch.setattr(bench, "TUNED_FILE", str(out))  # pre-flip: absent
+    monkeypatch.setattr(sys, "argv",
+                        ["apply_ladder", "--ladder", str(ladder),
+                         "--out", str(out)])
+    apply_ladder.main()
+
+    tuned = json.loads(out.read_text())
+    # l14: windowed row beats the measured unrolled default (280 > 250*1.02)
+    assert tuned["l14"]["remat_window"] == 8
+    assert tuned["l14"]["scan_blocks"] is True
+    # b16: 100.0 < 1.02 * 99.0 -> no entry, default stands
+    assert "b16" not in tuned
+    # tiny: default measured, no alternatives -> no entry
+    assert "tiny" not in tuned
+    # 10b_slice: the policy win rides into TUNED
+    assert tuned["10b_slice"]["remat_policy"] == "dots_saveable"
+
+    # bench.py defaults consult TUNED.json
+    assert bench.default_remat_window("l14") == 8
+    assert bench.default_scan_blocks("l14") is True
+    assert bench.default_scan_blocks("b16") is True   # untouched fallback
+    assert bench.default_remat_policy("10b_slice") == "dots_saveable"
+    assert bench.default_remat_policy("l14") == "dots_attn_saveable"
+    # explicit knob A/Bs pin the pre-TUNED policy
+    assert bench.default_remat_policy("10b_slice",
+                                      allow_tuned=False) == "none_saveable"

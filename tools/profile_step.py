@@ -31,7 +31,7 @@ def main():
     p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
                    default=None)
     p.add_argument("--scan_unroll", type=int, default=0)
-    p.add_argument("--remat_window", type=int, default=0)
+    p.add_argument("--remat_window", type=int, default=-1)
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -52,16 +52,17 @@ def main():
     device_kind = jax.devices()[0].device_kind
     # presets and remat defaults come FROM bench.py so traces explain exactly
     # the configs the bench measures
-    from bench import default_remat_policy, train_presets
+    from bench import train_presets
     kw = train_presets(n_dev)[args.preset]
     if args.batch_size:
         kw["batch_size"] = args.batch_size
-    remat = args.remat_policy or default_remat_policy(args.preset)
-    from bench import resolve_scan_knobs
-    args.scan_blocks, args.scan_unroll = resolve_scan_knobs(
-        args.scan_blocks, args.scan_unroll, args.preset,
-        remat_window=args.remat_window)
-    cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=remat,
+    from bench import resolve_bench_knobs
+    (args.scan_blocks, args.scan_unroll, args.remat_window,
+     args.remat_policy) = resolve_bench_knobs(
+        args.scan_blocks, args.scan_unroll, args.remat_window,
+        args.remat_policy, args.preset)
+    cfg = Config(num_classes=1000, warmup_steps=0,
+                 remat_policy=args.remat_policy,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,
                  remat_window=args.remat_window, **kw).validate()
 
