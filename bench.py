@@ -323,7 +323,8 @@ def default_remat_window(preset: str, allow_tuned: bool = True) -> int:
 
 
 def resolve_bench_knobs(scan_blocks, scan_unroll: int, remat_window: int,
-                        remat_policy, preset: str):
+                        remat_policy, preset: str,
+                        other_explicit: bool = False):
     """Resolve the full (scan_blocks, scan_unroll, remat_window,
     remat_policy) knob set from CLI values + per-preset defaults. Shared
     with tools/profile_step.py so traces explain exactly the configs the
@@ -339,7 +340,10 @@ def resolve_bench_knobs(scan_blocks, scan_unroll: int, remat_window: int,
     windowed-remat experiment, which forces the scan path even for presets
     whose measured default is unrolled (l14)."""
     explicit = (scan_blocks is not None or bool(scan_unroll)
-                or remat_window >= 0 or remat_policy is not None)
+                or remat_window >= 0 or remat_policy is not None
+                or other_explicit)  # any A/B lever: --no_grad_ckpt,
+    # --no_flash_attention, --batch_size — tuned knobs must not leak into
+    # (or crash: remat_window>1 needs grad_ckpt) a pure-knob comparison
     tuned_ok = not explicit
     if remat_window < 0:
         remat_window = default_remat_window(preset, allow_tuned=tuned_ok)
@@ -631,7 +635,9 @@ def bench_train(args, metric_stub: str) -> None:
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
-        args.remat_policy, args.preset)
+        args.remat_policy, args.preset,
+        other_explicit=(not args.grad_ckpt or not args.use_flash_attention
+                        or bool(args.batch_size)))
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll, remat_window=args.remat_window,

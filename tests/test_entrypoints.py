@@ -69,32 +69,34 @@ def test_apply_ladder_picks_measured_winners(tmp_path, monkeypatch):
     import json
     import importlib
 
-    def knobs(sb, su, rw, policy):
+    def knobs(sb, su, rw, policy, batch):
+        # batch must equal the preset's 1-chip default (train_presets(1)) or
+        # the row is deliberately non-comparable to the current default
         return {"scan_blocks": sb, "scan_unroll": su, "remat_window": rw,
-                "remat_policy": policy, "batch_size": 32}
+                "remat_policy": policy, "batch_size": batch}
 
     ladder = tmp_path / "ladder.jsonl"
     rows = [
         # l14 code default is the unrolled path: measure it, then beat it
         {"args": "--preset l14",
          "result": {"value": 250.0,
-                    "knobs": knobs(False, 1, 0, "dots_attn_saveable")}},
+                    "knobs": knobs(False, 1, 0, "dots_attn_saveable", 32)}},
         {"args": "--preset l14 --remat_window 8",
          "result": {"value": 280.0,
-                    "knobs": knobs(True, 1, 8, "dots_attn_saveable")}},
+                    "knobs": knobs(True, 1, 8, "dots_attn_saveable", 32)}},
         # b16: alternative beats the measured default by < min_gain -> keep
         {"args": "--preset b16 --no_scan_blocks",
          "result": {"value": 100.0,
-                    "knobs": knobs(False, 1, 0, "dots_attn_saveable")}},
+                    "knobs": knobs(False, 1, 0, "dots_attn_saveable", 64)}},
         # 10b_slice: a policy-only win must flip the policy along
         {"args": "--preset 10b_slice --remat_policy dots_saveable",
          "result": {"value": 130.0,
-                    "knobs": knobs(True, 1, 0, "dots_saveable")}},
+                    "knobs": knobs(True, 1, 0, "dots_saveable", 64)}},
         # ignored rows: truncated, errored-with-positive-value, non-knob
         {"args": "--preset l14 --scan_unroll", "result": {"value": 999.0}},
         {"args": "--preset l14 --remat_window 16",
          "result": {"value": 999.0, "error": "watchdog killed",
-                    "knobs": knobs(True, 1, 16, "dots_attn_saveable")}},
+                    "knobs": knobs(True, 1, 16, "dots_attn_saveable", 32)}},
         {"args": "--preset tiny --batch_size 8", "result": {"value": 999.0}},
     ]
     ladder.write_text("\n".join(json.dumps(r) for r in rows) + "\n")

@@ -364,7 +364,11 @@ def test_10b_slice_fits_single_chip_hbm(devices8):
         "on the TPU to re-prove the HBM fit, then update this pin")
     kw = train_presets(1)["10b_slice"] | dict(batch_size=8)
     cfg = Config(num_classes=1000, warmup_steps=0,
-                 remat_policy=default_remat_policy("10b_slice"),
+                 # allow_tuned=False: the HBM byte thresholds below were
+                 # measured under the pinned reference policy — a TUNED.json
+                 # policy flip must not silently change what this guard pins
+                 remat_policy=default_remat_policy("10b_slice",
+                                                   allow_tuned=False),
                  fsdp_size=1, **kw).validate()
     state, lowered = _lower_train_step(cfg, n_devices=1)
     compiled = lowered.compile()

@@ -30,7 +30,20 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-KNOB_KEYS = ("scan_blocks", "scan_unroll", "remat_window", "remat_policy")
+KNOB_KEYS = ("scan_blocks", "scan_unroll", "remat_window", "remat_policy",
+             "batch_size")  # batch rides along: img/s/chip from different
+#   batch sizes (or device counts implying them) are not comparable
+
+
+def parse_preset(args_str: str):
+    """Just the --preset value, tolerant of any other flags (rows carrying
+    the bench's own "knobs" record stay eligible even when their CLI line
+    has non-knob flags like --steps)."""
+    toks = args_str.split()
+    for i, t in enumerate(toks):
+        if t == "--preset" and i + 1 < len(toks):
+            return toks[i + 1]
+    return None
 
 
 def parse_knobs(args_str: str) -> dict:
@@ -78,8 +91,10 @@ def legacy_entry_knobs(knobs: dict) -> dict:
         su = default_scan_unroll(knobs["preset"], allow_tuned=False)
     policy = knobs["remat_policy"] or default_remat_policy(
         knobs["preset"], allow_tuned=False)
+    from bench import train_presets
+    batch = train_presets(1).get(knobs["preset"], {}).get("batch_size")
     return {"scan_blocks": sb, "scan_unroll": su, "remat_window": rw,
-            "remat_policy": policy}
+            "remat_policy": policy, "batch_size": batch}
 
 
 def main():
@@ -105,6 +120,12 @@ def main():
         with open(baseline_path) as f:
             baselines = json.load(f)
 
+    from bench import train_presets
+    presets_1dev = train_presets(1)
+
+    def preset_batch(preset):
+        return presets_1dev.get(preset, {}).get("batch_size")
+
     candidates = {}  # preset -> list of (img/s, knobs)
     for preset, entry in baselines.items():
         ips = entry.get("images_per_sec_chip") if isinstance(entry, dict) else None
@@ -114,7 +135,9 @@ def main():
                 "scan_unroll": entry.get("scan_unroll", 1),
                 "remat_window": entry.get("remat_window", 0),
                 "remat_policy": entry.get("remat_policy",
-                                          default_remat_policy(preset))}))
+                                          default_remat_policy(preset)),
+                "batch_size": entry.get("batch_size",
+                                        preset_batch(preset))}))
 
     if os.path.exists(args.ladder):
         with open(args.ladder) as f:
@@ -124,22 +147,26 @@ def main():
                     continue
                 try:
                     row = json.loads(line)
-                    cli = parse_knobs(row["args"])
+                    preset = parse_preset(row["args"])
                     result = row["result"]
                     value = float(result["value"])
                     errored = "error" in result
-                except (json.JSONDecodeError, KeyError, TypeError, ValueError):
-                    continue
-                if not cli.get("preset") or value <= 0 or errored:
+                except (json.JSONDecodeError, KeyError, TypeError,
+                        ValueError, AttributeError):
+                    continue  # one malformed line must never kill the loop
+                if not preset or value <= 0 or errored:
                     # an "error" row with a positive partial value (e.g. a
                     # watchdog kill mid-run) must never become the default
                     continue
                 rec = result.get("knobs")
-                knobs = ({k: rec[k] for k in KNOB_KEYS}
-                         if isinstance(rec, dict)
-                         and all(k in rec for k in KNOB_KEYS)
-                         else legacy_entry_knobs(cli))
-                candidates.setdefault(cli["preset"], []).append((value, knobs))
+                if isinstance(rec, dict) and all(k in rec for k in KNOB_KEYS):
+                    knobs = {k: rec[k] for k in KNOB_KEYS}  # ground truth
+                else:
+                    cli = parse_knobs(row["args"])  # legacy pure-knob rows
+                    if not cli.get("preset"):
+                        continue
+                    knobs = legacy_entry_knobs(cli)
+                candidates.setdefault(preset, []).append((value, knobs))
 
     tuned = {}
     if os.path.exists(args.out):  # preserve prior decisions for other presets
@@ -154,7 +181,8 @@ def main():
         current = {"scan_blocks": default_scan_blocks(preset),
                    "scan_unroll": default_scan_unroll(preset),
                    "remat_window": default_remat_window(preset),
-                   "remat_policy": default_remat_policy(preset)}
+                   "remat_policy": default_remat_policy(preset),
+                   "batch_size": preset_batch(preset)}
         cur_meas = max((v for v, k in rows if k == current), default=None)
         if cur_meas is None:
             print(f"{preset}: current default {current} has no measurement "

@@ -60,7 +60,8 @@ def main():
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
-        args.remat_policy, args.preset)
+        args.remat_policy, args.preset,
+        other_explicit=bool(args.batch_size))
     cfg = Config(num_classes=1000, warmup_steps=0,
                  remat_policy=args.remat_policy,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,
