@@ -725,8 +725,10 @@ def bench_train(args, metric_stub: str) -> None:
         "vs_baseline": vs_baseline,
         # the RESOLVED knob set this number was measured under — ground
         # truth for tools/apply_ladder.py (reconstructing knobs from CLI
-        # flags drifts once TUNED.json changes the defaults)
-        "knobs": {"batch_size": cfg.batch_size,
+        # flags drifts once TUNED.json changes the defaults). Batch is
+        # PER-CHIP: img/s/chip numbers only compare at equal per-chip batch,
+        # independent of how many devices the host had
+        "knobs": {"batch_per_chip": cfg.batch_size // n_dev,
                   "remat_policy": cfg.remat_policy,
                   "scan_blocks": cfg.scan_blocks,
                   "scan_unroll": cfg.scan_unroll,

@@ -35,7 +35,7 @@ def test_bench_prints_one_json_line():
     assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
     assert result["unit"] == "images/sec/chip"
     assert result["value"] > 0
-    assert result["knobs"]["batch_size"] == 8
+    assert result["knobs"]["batch_per_chip"] == 1  # global 8 over 8 devices
 
 
 @pytest.mark.slow
@@ -70,10 +70,10 @@ def test_apply_ladder_picks_measured_winners(tmp_path, monkeypatch):
     import importlib
 
     def knobs(sb, su, rw, policy, batch):
-        # batch must equal the preset's 1-chip default (train_presets(1)) or
-        # the row is deliberately non-comparable to the current default
+        # per-chip batch must equal the preset's default (train_presets(1))
+        # or the row is deliberately non-comparable to the current default
         return {"scan_blocks": sb, "scan_unroll": su, "remat_window": rw,
-                "remat_policy": policy, "batch_size": batch}
+                "remat_policy": policy, "batch_per_chip": batch}
 
     ladder = tmp_path / "ladder.jsonl"
     rows = [

@@ -31,8 +31,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 KNOB_KEYS = ("scan_blocks", "scan_unroll", "remat_window", "remat_policy",
-             "batch_size")  # batch rides along: img/s/chip from different
-#   batch sizes (or device counts implying them) are not comparable
+             "batch_per_chip")  # per-chip batch rides along: img/s/chip
+#   from different per-chip batches is not comparable (and per-chip is
+#   device-count independent, so multi-chip watcher hosts still match)
+
+
+def preset_batch_per_chip(preset):
+    """The preset's default PER-CHIP batch (train_presets at n_dev=1)."""
+    from bench import train_presets
+    return train_presets(1).get(preset, {}).get("batch_size")
 
 
 def parse_preset(args_str: str):
@@ -91,10 +98,9 @@ def legacy_entry_knobs(knobs: dict) -> dict:
         su = default_scan_unroll(knobs["preset"], allow_tuned=False)
     policy = knobs["remat_policy"] or default_remat_policy(
         knobs["preset"], allow_tuned=False)
-    from bench import train_presets
-    batch = train_presets(1).get(knobs["preset"], {}).get("batch_size")
     return {"scan_blocks": sb, "scan_unroll": su, "remat_window": rw,
-            "remat_policy": policy, "batch_size": batch}
+            "remat_policy": policy,
+            "batch_per_chip": preset_batch_per_chip(knobs["preset"])}
 
 
 def main():
@@ -120,12 +126,6 @@ def main():
         with open(baseline_path) as f:
             baselines = json.load(f)
 
-    from bench import train_presets
-    presets_1dev = train_presets(1)
-
-    def preset_batch(preset):
-        return presets_1dev.get(preset, {}).get("batch_size")
-
     candidates = {}  # preset -> list of (img/s, knobs)
     for preset, entry in baselines.items():
         ips = entry.get("images_per_sec_chip") if isinstance(entry, dict) else None
@@ -136,8 +136,11 @@ def main():
                 "remat_window": entry.get("remat_window", 0),
                 "remat_policy": entry.get("remat_policy",
                                           default_remat_policy(preset)),
-                "batch_size": entry.get("batch_size",
-                                        preset_batch(preset))}))
+                # stored rows record the GLOBAL batch + device count
+                "batch_per_chip": (entry["batch_size"] // entry["n_devices"]
+                                   if entry.get("batch_size")
+                                   and entry.get("n_devices")
+                                   else preset_batch_per_chip(preset))}))
 
     if os.path.exists(args.ladder):
         with open(args.ladder) as f:
@@ -159,13 +162,16 @@ def main():
                     # watchdog kill mid-run) must never become the default
                     continue
                 rec = result.get("knobs")
-                if isinstance(rec, dict) and all(k in rec for k in KNOB_KEYS):
-                    knobs = {k: rec[k] for k in KNOB_KEYS}  # ground truth
-                else:
-                    cli = parse_knobs(row["args"])  # legacy pure-knob rows
-                    if not cli.get("preset"):
-                        continue
-                    knobs = legacy_entry_knobs(cli)
+                try:
+                    if isinstance(rec, dict) and all(k in rec for k in KNOB_KEYS):
+                        knobs = {k: rec[k] for k in KNOB_KEYS}  # ground truth
+                    else:
+                        cli = parse_knobs(row["args"])  # legacy pure-knob rows
+                        if not cli.get("preset"):
+                            continue
+                        knobs = legacy_entry_knobs(cli)
+                except (KeyError, TypeError, ValueError):
+                    continue  # malformed knob values: skip, never crash
                 candidates.setdefault(preset, []).append((value, knobs))
 
     tuned = {}
@@ -182,7 +188,13 @@ def main():
                    "scan_unroll": default_scan_unroll(preset),
                    "remat_window": default_remat_window(preset),
                    "remat_policy": default_remat_policy(preset),
-                   "batch_size": preset_batch(preset)}
+                   "batch_per_chip": preset_batch_per_chip(preset)}
+        # challengers at a different per-chip batch are not comparable to
+        # the default's img/s/chip — drop them BEFORE the argmax
+        rows = [r for r in rows
+                if r[1].get("batch_per_chip") == current["batch_per_chip"]]
+        if not rows:
+            continue
         cur_meas = max((v for v, k in rows if k == current), default=None)
         if cur_meas is None:
             print(f"{preset}: current default {current} has no measurement "
