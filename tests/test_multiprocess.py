@@ -27,15 +27,40 @@ def _free_port() -> int:
 
 
 
-def _tiny_train_argv(steps_per_epoch, ckpt_dir):
+def _tiny_train_argv(steps_per_epoch, ckpt_dir, num_blocks=2):
     return [sys.executable, "run_vit_training.py", "--fake_data",
             "--image_size", "32", "--patch_size", "8", "--embed_dim", "32",
-            "--num_heads", "2", "--num_blocks", "2", "--num_classes", "4",
+            "--num_heads", "2", "--num_blocks", str(num_blocks),
+            "--num_classes", "4",
             "--batch_size", "16", "--dtype", "float32", "--num_epochs", "1",
             "--steps_per_epoch", str(steps_per_epoch),
             "--log_step_interval", "1", "--warmup_steps", "0",
             "--eval_max_batches", "1", "--test_epoch_interval", "99",
             "--ckpt_epoch_interval", "99", "--ckpt_dir", str(ckpt_dir)]
+
+
+def _run_two_procs(argv, port, timeout=600):
+    """Spawn the same argv as 2 coordinated processes; return their merged
+    stdout+stderr logs after asserting both exited 0 (kills orphans on
+    timeout/assert — e.g. a wedged barrier)."""
+    procs = []
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            argv, cwd=REPO, env=_two_proc_env(port, pid),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
 
 
 def _two_proc_env(port, pid):
@@ -52,24 +77,7 @@ def _two_proc_env(port, pid):
 @pytest.mark.slow
 def test_two_process_training(tmp_path):
     port = _free_port()
-    procs = []
-    for pid in range(2):
-        procs.append(subprocess.Popen(
-            _tiny_train_argv(3, tmp_path / "ckpt"),
-            cwd=REPO, env=_two_proc_env(port, pid), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=600)
-            outs.append(out)
-        for pid, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
-    finally:
-        for p in procs:  # no orphans on timeout/assert (e.g. a wedged barrier)
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+    outs = _run_two_procs(_tiny_train_argv(3, tmp_path / "ckpt"), port)
 
     # rank 0 logs; the loop must have seen 2 processes and 8 global devices
     log = outs[0]
@@ -134,3 +142,40 @@ def test_two_process_preemption_agreement(tmp_path):
     assert "SIGTERM received: saving preemption checkpoint" in out0, out0[-3000:]
     assert (tmp_path / "ckpt" / "epoch_1").is_dir()
     assert "training completed" in out0  # clean exit path, not a crash
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_training(tmp_path):
+    """GPipe under a MULTI-HOST mesh: dp2 x fsdp2 x pp2 on 2 processes x 4
+    devices. By construction pp's mesh stride is 1 (it is the second-to-last
+    axis), so stage hops ride intra-process links — the deliberate topology
+    placement (vitax/parallel/pipeline.py: stage hops belong on the closest
+    links) — while the dp gradient reduction crosses the Gloo transport
+    AROUND the pipeline's shard_map. That composition (multi-host data
+    parallelism over a pipelined step program) is what single-process pp
+    tests cannot cover. Logged losses must match a single-process run of
+    the SAME global config."""
+    port = _free_port()
+    argv = _tiny_train_argv(3, tmp_path / "ckpt", num_blocks=4) + [
+        "--dp_size", "2", "--fsdp_size", "2", "--pp_size", "2"]
+    outs = _run_two_procs(argv, port)
+
+    log = outs[0]
+    assert "'pp': 2" in log and "(2 host(s))" in log, log[-2000:]
+    assert "training completed" in log
+    losses_2p = [float(x) for x in re.findall(r"loss: ([0-9.]+)", log)]
+    assert losses_2p and all(x > 0 for x in losses_2p)
+
+    # single-process reference: same global mesh on 8 local devices
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    ref = subprocess.run(
+        _tiny_train_argv(3, tmp_path / "ckpt_ref", num_blocks=4) + [
+            "--dp_size", "2", "--fsdp_size", "2", "--pp_size", "2"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=600)
+    assert ref.returncode == 0, ref.stdout[-3000:]
+    losses_1p = [float(x) for x in re.findall(r"loss: ([0-9.]+)", ref.stdout)]
+    assert len(losses_1p) == len(losses_2p)
+    for a, b in zip(losses_2p, losses_1p):
+        assert abs(a - b) < 2e-4 * max(abs(b), 1.0), (losses_2p, losses_1p)

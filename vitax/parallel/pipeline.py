@@ -16,6 +16,12 @@ running the stage schedule inside `jax.shard_map`:
 - The last stage's valid outputs are the tick outputs [S-1, S-1+M); a psum
   over "pp" (one nonzero contributor) replicates them so the head/loss run
   under plain GSPMD afterwards.
+- Topology placement: "pp" is the second-to-last mesh axis ("ep" is last
+  and batch-like), so pp neighbors are mesh-ADJACENT device ids — on pods
+  the per-tick stage hop always rides the closest ICI links and never the
+  host boundary; the dp/fsdp axes (larger strides) carry the cross-host
+  traffic, which is amortized once per step (grad reduction), not once per
+  tick. tests/test_multiprocess.py exercises exactly that composition.
 - Backward is plain autodiff through the scan/ppermute: bubble-tick
   computations receive zero cotangents (their outputs are masked), so only
   real microbatches contribute gradients, which land on each stage's own
