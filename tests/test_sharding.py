@@ -52,8 +52,9 @@ class TestMeshResolution:
             resolve_mesh_shape(tiny_cfg(dp_size=-1, fsdp_size=-1), 8)
         with pytest.raises(ValueError):
             resolve_mesh_shape(tiny_cfg(run_without_fsdp=True, fsdp_size=4), 8)
-        with pytest.raises(ValueError):  # pp does not compose with tp/sp (v1)
-            resolve_mesh_shape(tiny_cfg(pp_size=2, tp_size=2), 8)
+        # pp composes with tp/sp since round 4 (vitax/parallel/pipeline.py)
+        shape = resolve_mesh_shape(tiny_cfg(pp_size=2, tp_size=2), 8)
+        assert shape[2] == 2 and shape[4] == 2 and int(np.prod(shape)) == 8
 
 
 class TestParamSpecs:

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 import jax
+import os
 
 from vitax.config import Config
 from vitax.models import build_model
@@ -160,34 +161,34 @@ def test_full_loop_fake_data(devices8, tmp_path):
     assert os.path.isdir(os.path.join(str(tmp_path / "ckpt"), "epoch_1"))
 
 
-def test_compile_cache_dir_populates(devices8, tmp_path):
+def test_compile_cache_dir_populates(tmp_path):
     """--compile_cache_dir persists compiled step programs so restarts
-    (launcher --restart, preemption resume) skip recompilation. train()
-    mutates global jax.config, so save/restore it here (an empty flag means
-    'no opinion' — later trains in this process would otherwise inherit the
-    dir); threshold 0 makes persistence deterministic for the fast-compiling
-    tiny program."""
-    import os
+    (launcher --restart, preemption resume) skip recompilation. Runs the
+    REAL CLI in a subprocess: enabling the persistent cache mutates global
+    jax.config and serializes executables, and doing that inside this
+    process after ~200 suite tests aborted the interpreter twice (native
+    crash in the cache write path with accumulated XLA state) — subprocess
+    isolation matches how the flag is actually used (one cache per run)."""
+    import subprocess
+    import sys
 
-    from vitax.train.loop import train
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     cache = tmp_path / "xla_cache"
-    cfg = tiny_cfg(
-        fake_data=True, num_epochs=1, steps_per_epoch=2, log_step_interval=1,
-        ckpt_dir=str(tmp_path / "ckpt"), ckpt_epoch_interval=1,
-        test_epoch_interval=10, num_workers=1, batch_size=16,
-        compile_cache_dir=str(cache),
-    )
-    prev_dir = jax.config.jax_compilation_cache_dir
-    prev_thresh = jax.config.jax_persistent_cache_min_compile_time_secs
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    try:
-        train(cfg)
-        entries = os.listdir(cache)
-        assert entries, "compile cache dir was never populated"
-    finally:
-        jax.config.update("jax_compilation_cache_dir", prev_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          prev_thresh)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0")
+    r = subprocess.run(
+        [sys.executable, "run_vit_training.py", "--fake_data",
+         "--image_size", "32", "--patch_size", "8", "--embed_dim", "32",
+         "--num_heads", "4", "--num_blocks", "2", "--batch_size", "16",
+         "--num_epochs", "1", "--steps_per_epoch", "2",
+         "--log_step_interval", "1", "--test_epoch_interval", "10",
+         "--num_workers", "1", "--ckpt_dir", str(tmp_path / "ckpt"),
+         "--compile_cache_dir", str(cache)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1500)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert cache.is_dir() and os.listdir(cache), (
+        "compile cache dir was never populated")
 
 
 def test_sigterm_preemption_save(devices8, tmp_path):

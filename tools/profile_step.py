@@ -102,7 +102,8 @@ def main():
     flops = model_flops_per_image(cfg) * cfg.batch_size
     peak = detect_peak_tflops(device_kind)
     mfu = flops / (dt / args.steps) / (peak * 1e12 * n_dev)
-    print(f"\n== {args.preset} remat={remat} batch={cfg.batch_size}: "
+    print(f"\n== {args.preset} remat={args.remat_policy} "
+          f"batch={cfg.batch_size}: "
           f"{step_ms:.1f} ms/step, MFU {mfu:.3f} ({device_kind}) ==")
 
     xplanes = sorted(glob.glob(

@@ -153,6 +153,10 @@ class Config:
                     "--moe_experts under --pp_size > 1 needs experts "
                     "replicated (--ep_size 1): expert sharding inside the "
                     "manual pipeline body would need its own all-to-alls")
+                assert self.tp_size == 1 and self.sp_size == 1, (
+                    "--moe_experts under --pp_size > 1 composes with dp/fsdp "
+                    "only: the MoE dispatch einsums inside the pipeline body "
+                    "are not exercised under auto-tp/sp meshes")
             if self.pp_schedule == "1f1b":
                 assert max(self.pos_dropout, self.att_dropout,
                            self.mlp_dropout) == 0.0 and self.moe_experts == 0, (
@@ -160,6 +164,10 @@ class Config:
                     "(dropout and MoE ride the gpipe schedule); the "
                     "interleaved backward always recomputes the stage "
                     "forward (none_saveable semantics)")
+                assert self.tp_size == 1 and self.sp_size == 1, (
+                    "--pp_schedule 1f1b runs a fully-manual shard_map "
+                    "engine; tp/sp under pp ride the gpipe schedule "
+                    "(GSPMD-auto axes in the pipeline body)")
         if self.ep_size > 1:
             assert self.moe_experts > 0, "--ep_size > 1 needs --moe_experts"
             assert self.moe_experts % self.ep_size == 0, (

@@ -519,25 +519,37 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
             if cfg.num_heads % (sp * tp) == 0:
                 # all-to-all head<->token resharding; the inner kernel sees
                 # the full sequence, so the Pallas cores apply on TPU
-                from vitax.parallel.ulysses import make_ulysses_attention
+                from vitax.parallel.ulysses import (make_ulysses_attention,
+                                                    make_ulysses_attention_pp)
                 inner, _ = _tpu_kernel(cfg, n, force=force_tpu_kernels,
                                        local_heads=cfg.num_heads // (sp * tp))
-                return _named(make_ulysses_attention(mesh, inner),
-                              "ulysses all-to-all (sp)")
+                wrapped = _named(make_ulysses_attention(mesh, inner),
+                                 "ulysses all-to-all (sp)")
+                # pp x sp: manualize only (sp, tp) inside the pipeline body
+                wrapped.vitax_pp_impl = _named(
+                    make_ulysses_attention_pp(inner, with_tp=tp > 1),
+                    "ulysses all-to-all (sp, pp body)")
+                return wrapped
             from vitax.utils.logging import master_print
             master_print(
                 f"WARNING: --sp_impl ulysses needs num_heads divisible by "
                 f"sp*tp ({cfg.num_heads} % {sp * tp} != 0); falling back to "
                 f"ring attention")
-        from vitax.parallel.ring_attention import make_ring_attention
+        from vitax.parallel.ring_attention import (make_ring_attention,
+                                                   make_ring_attention_pp)
         # local block product through the Pallas kernels on TPU (whole-N or
         # streaming by local length), dense jnp when kernels are disabled
         if not cfg.use_flash_attention:
             use_kernel = False
         else:
             use_kernel = True if force_tpu_kernels else None  # None = on-TPU
-        return _named(make_ring_attention(mesh, use_kernel=use_kernel),
-                      "ring attention (sp)")
+        wrapped = _named(make_ring_attention(mesh, use_kernel=use_kernel),
+                         "ring attention (sp)")
+        # pp x sp: manualize only (sp, tp) inside the pipeline body
+        wrapped.vitax_pp_impl = _named(
+            make_ring_attention_pp(use_kernel=use_kernel, with_tp=tp > 1),
+            "ring attention (sp, pp body)")
+        return wrapped
 
     if mesh is not None and mesh.size > 1 and cfg.num_heads % tp != 0:
         return None
@@ -556,8 +568,20 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
         check_vma=False,
     ), name + " + shard_map")
     # expose the unwrapped kernel for callers that run attention inside
-    # their OWN shard_map (the pp pipeline body) — nesting shard_map over
-    # the same mesh is rejected by JAX, and inside the body the operands
-    # are already local
+    # their OWN shard_map (the pp pipeline body): when the mesh has no tp,
+    # the body's operands are already fully local, so the raw kernel applies
+    # (vitax_local_impl). Under tp > 1 no kernel variant is usable in the
+    # body — vitax_pp_impl is explicitly None there (see below).
     wrapped.vitax_local_impl = _named(kernel, name)
+    if mesh.shape.get("tp", 1) > 1:
+        # pp body under tp: "tp" is a GSPMD-auto axis there and a Pallas
+        # kernel cannot be auto-partitioned (and a nested tp shard_map hits
+        # the jax-0.9 Shardy constant-hoisting bug — see
+        # vitax/parallel/pipeline.py). None routes the Block to the dense
+        # einsum path, which GSPMD partitions over the tp-global head dim.
+        # At ViT sequence lengths attention is a few percent of block FLOPs,
+        # so the unfused path costs little; the scan path keeps the kernel.
+        wrapped.vitax_pp_impl = None
+    else:
+        wrapped.vitax_pp_impl = wrapped.vitax_local_impl
     return wrapped

@@ -12,8 +12,8 @@ devices arranged in a 6-axis `jax.sharding.Mesh`:
 - "tp":   tensor parallelism (attention heads / MLP hidden sharded)
 - "sp":   sequence/context parallelism (ring attention over the token axis)
 - "pp":   pipeline parallelism (GPipe stages over the stacked layer axis —
-          vitax/parallel/pipeline.py; composes with dp and fsdp/ZeRO-3,
-          v1 excludes tp/sp)
+          vitax/parallel/pipeline.py; composes with dp, fsdp/ZeRO-3, and
+          tp/sp — the latter ride as GSPMD-auto axes inside the body)
 - "ep":   expert parallelism (vitax/models/moe.py) — carries batch like dp,
           and MoE expert weights shard their leading (E, ...) dim across it;
           GSPMD inserts the batch<->expert all-to-alls from the specs
@@ -41,9 +41,10 @@ def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[in
     """Resolve (dp, fsdp, tp, sp, pp, ep) against the device count. One axis may be
     -1 (= all remaining devices). `--run_without_fsdp` forces everything onto dp
     (the reference's pure-DP baseline, run_vit_training.py:171-172). Pipeline
-    parallelism (pp > 1) composes with dp and fsdp (ZeRO-3 gathers run
-    just-in-time inside the pipeline body); tp/sp under pp are excluded in v1
-    (see vitax/parallel/pipeline.py)."""
+    parallelism (pp > 1) composes with dp, fsdp (ZeRO-3 gathers run
+    just-in-time inside the pipeline body), and tp/sp (GSPMD-auto axes
+    inside the body — see vitax/parallel/pipeline.py; the 1F1B schedule
+    and MoE-under-pp remain dense/tp-free, enforced by Config.validate)."""
     n = n_devices if n_devices is not None else jax.device_count()
     dp, fsdp, tp, sp = cfg.dp_size, cfg.fsdp_size, cfg.tp_size, cfg.sp_size
     pp = getattr(cfg, "pp_size", 1)
@@ -57,10 +58,6 @@ def resolve_mesh_shape(cfg: Config, n_devices: Optional[int] = None) -> Tuple[in
             dp = -1  # default DP baseline: all devices data-parallel
 
     if pp > 1:
-        if tp != 1 or sp != 1:
-            raise ValueError(
-                f"--pp_size {pp} does not compose with tp/sp (v1): got "
-                f"tp={tp} sp={sp}")
         # fsdp composes: ZeRO-3 shards are gathered just-in-time inside the
         # pipeline body (vitax/parallel/pipeline.py). With --fsdp_size 1 the
         # remaining devices default to carrying the batch on dp; an explicit

@@ -27,9 +27,23 @@ running the stage schedule inside `jax.shard_map`:
   real microbatches contribute gradients, which land on each stage's own
   param shard.
 
-Composes with dp AND fsdp/ZeRO-3 (tp/sp are excluded): block params may
-carry "fsdp" placements on their weight dims in addition to "pp" on the
-layer dim. Inside the pipeline body each block's leaves are all-gathered
+Composes with dp, fsdp/ZeRO-3, AND tp/sp: block params may carry "fsdp"
+placements on their weight dims in addition to "pp" on the layer dim, and
+"tp" placements on their Megatron dims.
+- sp rides as another MANUAL axis of the pipeline shard_map: activations
+  keep their token dim sharded over "sp" through the whole schedule, and
+  the ring/ulysses LOCAL bodies run directly inside the already-manual
+  region (vitax_pp_impl — no nested shard_map: in jax 0.9 a nested
+  partial-manual map hoists its closure constants into sdy wrappers whose
+  all-axes sharding encodings violate Shardy's manual-before-free ordering).
+- tp stays a GSPMD-AUTO axis: the shard_map manualizes every mesh axis
+  except "tp" (with vma tracking on, so autodiff residual specs are
+  inferred precisely), and the compiler partitions the block matmuls from
+  the weights' own Megatron placements exactly as on the scan path.
+  Attention under tp uses the dense einsum path (GSPMD shards it over the
+  tp-global head dim; a Pallas kernel cannot be auto-partitioned — at ViT
+  sequence lengths attention is a few percent of block FLOPs).
+Inside the pipeline body each block's leaves are all-gathered
 over "fsdp" right before use — the manual form of the per-block gather
 GSPMD emits on the scan path — and autodiff's transpose of that gather is
 a reduce-scatter, so gradients land back on the ZeRO-3 shards. With remat
@@ -72,6 +86,18 @@ def _gather_over(x, spec: P, axis_name: str):
     return x
 
 
+def _drop_tp(spec: P) -> P:
+    """Strip "tp" placements from a PartitionSpec: when tp is a GSPMD-auto
+    axis, partial-manual shard_map in_specs may only name manual axes; the
+    tp sharding rides on the arrays' own NamedShardings."""
+    def fix(entry):
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a != "tp")
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return None if entry == "tp" else entry
+    return P(*(fix(e) for e in spec))
+
+
 def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     """(params, images, det=True, rng=None, with_aux=False) -> logits or
     (logits, moe_aux), GPipe-pipelined over "pp".
@@ -98,25 +124,68 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     if moe:
         assert mesh.shape["ep"] == 1, (
             "MoE under pp needs experts replicated (--ep_size 1)")
+    # tp present: partial-manual shard_map (tp stays GSPMD-auto) with vma
+    # tracking (see the shard_map call below); absent: full-manual,
+    # round-3 behavior. sp is ALWAYS manual: the ring/ulysses bodies run
+    # directly in the pipeline body over the in-scope "sp" axis.
+    tp_auto = mesh.shape["tp"] > 1
+    if (tp_auto and cfg.dtype == "bfloat16"
+            and jax.devices()[0].platform == "cpu"):
+        from vitax.utils.logging import master_print
+        master_print(
+            "WARNING: pp x tp with bf16 on the CPU backend crashes XLA's "
+            "operand_upcaster pass (CPU bf16-dot emulation mishandles "
+            "partitioner-generated copies in the pipeline's scan loops). "
+            "This pass does not exist in TPU's native-bf16 compile "
+            "pipeline. Use --dtype float32 for CPU runs of this mesh.")
+    sp = mesh.shape["sp"]
+    if sp > 1:
+        assert cfg.num_patches % sp == 0, (
+            f"pp x sp needs num_patches {cfg.num_patches} divisible by "
+            f"sp {sp}")
+        assert cfg.att_dropout == 0.0, (
+            "pp x sp excludes --att_dropout > 0: the Block's dropout "
+            "fallback computes dense attention, which is wrong on a local "
+            "token shard")
     has_block_dropout = cfg.att_dropout > 0 or cfg.mlp_dropout > 0
 
     # the model's attention impl may be shard_map-wrapped (multi-device
-    # meshes); inside pipeline_body we are ALREADY inside shard_map and the
-    # operands are local, so unwrap to the raw kernel (same selection,
-    # including the dryrun's interpret-mode forcing)
+    # meshes); inside pipeline_body the batch/pp/sp axes are ALREADY manual,
+    # so swap to the pp-body variant: the raw local kernel when tp/sp are
+    # absent, the LOCAL ring/ulysses body under sp (the "sp" axis is in
+    # scope), or None under tp (dense einsum path — GSPMD partitions it
+    # over the tp-auto head dim). Same selection, incl. the dryrun's
+    # interpret-mode forcing.
     bk = model.block_kwargs()
+    _impl = bk["attention_impl"]
     bk["attention_impl"] = getattr(
-        bk["attention_impl"], "vitax_local_impl", bk["attention_impl"])
+        _impl, "vitax_pp_impl", getattr(_impl, "vitax_local_impl", _impl))
+    if sp > 1:
+        # under manual sp the Block's dense fallback would softmax each
+        # LOCAL N/sp token shard as if it were the full sequence —
+        # shape-correct, silently wrong. The body impl must be sp-aware
+        # (ring/ulysses local); it is None when make_attention_impl bailed
+        # (e.g. num_heads % tp != 0) or the model was built without one.
+        assert bk["attention_impl"] is not None, (
+            "pp x sp needs an sp-aware attention impl in the pipeline body "
+            "(ring/ulysses via make_attention_impl); got None — check "
+            "num_heads divisibility by tp (and sp*tp for ulysses)")
     # mesh-level sharding anchors are meaningless on the per-device values
     # inside shard_map (and NamedSharding constraints are illegal there)
     bk["token_sharding"] = None
     bk["moe_dispatch_sharding"] = None
     block = Block(**bk)
 
-    # per-layer specs: drop the leading (stacked/"pp") dim of each leaf spec
+    # manual-axis view of the block specs: tp placements are stripped when
+    # tp is GSPMD-auto (the arrays' own NamedShardings carry them), then
+    # per-layer specs drop the leading (stacked/"pp") dim of each leaf spec
     is_spec = lambda x: isinstance(x, P)  # noqa: E731
-    layer_specs = (None if block_specs is None else jax.tree.map(
-        lambda s: P(*s[1:]), block_specs, is_leaf=is_spec))
+    manual_block_specs = (None if block_specs is None else
+                          (jax.tree.map(_drop_tp, block_specs,
+                                        is_leaf=is_spec)
+                           if tp_auto else block_specs))
+    layer_specs = (None if manual_block_specs is None else jax.tree.map(
+        lambda s: P(*s[1:]), manual_block_specs, is_leaf=is_spec))
 
     def make_one_block(det: bool, collect_aux: bool):
         def one_block(carry, xs):
@@ -177,6 +246,13 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
                 (jax.lax.axis_index("dp") * mesh.shape["fsdp"]
                  + jax.lax.axis_index("fsdp")) * mesh.shape["ep"]
                 + jax.lax.axis_index("ep"))
+            # sp shards hold DIFFERENT tokens of the same samples — their
+            # mlp-dropout masks (drawn inside the body) must be independent
+            # too (identity when sp == 1: idx*1 + 0). Pos dropout runs
+            # OUTSIDE the pipeline shard_map (plain GSPMD in forward()),
+            # so it is not affected by this fold.
+            shard_idx = (shard_idx * mesh.shape["sp"]
+                         + jax.lax.axis_index("sp"))
             base_key = jax.random.fold_in(
                 jax.random.wrap_key_data(key_data), shard_idx)
             b_loc = x.shape[0]
@@ -212,8 +288,14 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
 
             acc0 = (jnp.zeros((Lps, cfg.moe_experts), jnp.float32),) * 2 \
                 if collect_aux else (jnp.float32(0.0),) * 2
+            buf0 = jnp.zeros_like(mbs[0])
+            if tp_auto:
+                # under vma tracking (the partial-manual tp path) the
+                # carry's type must declare it varies over pp — the tick
+                # output does (each stage holds a different activation)
+                buf0 = jax.lax.pcast(buf0, ("pp",), to="varying")
             (_, acc_f, acc_p), ys = jax.lax.scan(
-                tick, (jnp.zeros_like(mbs[0]), *acc0),
+                tick, (buf0, *acc0),
                 jnp.arange(M + S - 1))
             outs = ys[S - 1:S - 1 + M]          # microbatch i at tick S-1+i
             outs = jax.lax.psum(outs, "pp")     # one nonzero contributor
@@ -234,7 +316,8 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
 
         return pipeline_body
 
-    act_spec = P(BATCH_AXES, None, None)
+    # tokens ride the manual "sp" axis when sequence parallelism is active
+    act_spec = P(BATCH_AXES, "sp" if sp > 1 else None, None)
 
     def stacked_specs(tree):
         return jax.tree.map(
@@ -270,13 +353,21 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
         pipeline_body = make_pipeline_body(not use_dropout, with_aux)
 
         stacked = p["blocks"]
-        in_specs = (block_specs if block_specs is not None
+        in_specs = (manual_block_specs if manual_block_specs is not None
                     else stacked_specs(stacked))
+        # tp absent: manualize every axis with vma checking off — the
+        # autodiff residuals' conservative all-axes out_specs are legal
+        # there (round-3 behavior, bit-identical). tp present: manualize
+        # everything BUT tp and turn vma tracking ON — the residual
+        # out_specs must then be inferred precisely, since naming an auto
+        # axis in out_specs is an error.
         run = jax.shard_map(
             pipeline_body, mesh=mesh,
             in_specs=(in_specs, P(), act_spec),
             out_specs=(act_spec, P()),
-            check_vma=False)
+            axis_names=(frozenset(mesh.axis_names) - {"tp"} if tp_auto
+                        else frozenset(mesh.axis_names)),
+            check_vma=tp_auto)
         x, aux = run(stacked, jax.random.key_data(rng), x)
 
         logits = apply_tail(p, x, num_classes=cfg.num_classes, dtype=dtype)

@@ -128,3 +128,31 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
         return fn(q, k, v)
 
     return ring_attention
+
+
+def make_ring_attention_pp(axis_name: str = "sp",
+                           use_kernel: Optional[bool] = None,
+                           with_tp: bool = False):
+    """Ring attention for use INSIDE the pipeline body (pp x sp composition).
+
+    The pipeline shard_map manualizes "sp" itself (vitax/parallel/pipeline.py
+    — a NESTED shard_map would hoist its closure constants into
+    manual-computation wrappers whose all-axes sharding encodings Shardy
+    rejects in jax 0.9), so this is simply the LOCAL ring body called
+    directly in the already-manual region: operands are the per-device
+    (B_loc, N/sp, H, Dh) shards and the ppermute rotates over the in-scope
+    "sp" axis. With tp active (with_tp — tp stays a GSPMD-auto axis in the
+    body), the block product must be the dense einsum path: GSPMD partitions
+    the einsums over the tp-global head dim, whereas a Pallas kernel cannot
+    be auto-partitioned."""
+    if use_kernel is None:
+        use_kernel = jax.devices()[0].platform == "tpu"
+    block_fn = _kernel_block if (use_kernel and not with_tp) else _dense_block
+
+    def ring_attention_local(q: jax.Array, k: jax.Array,
+                             v: jax.Array) -> jax.Array:
+        scale = q.shape[-1] ** -0.5
+        return _ring_attention_local(q, k, v, axis_name=axis_name,
+                                     scale=scale, block_fn=block_fn)
+
+    return ring_attention_local

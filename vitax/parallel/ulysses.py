@@ -70,3 +70,20 @@ def make_ulysses_attention(mesh: Mesh, inner: Optional[Callable] = None,
         return fn(q, k, v)
 
     return ulysses_attention
+
+
+def make_ulysses_attention_pp(inner: Optional[Callable] = None,
+                              axis_name: str = "sp", with_tp: bool = False):
+    """Ulysses attention for use INSIDE the pipeline body (pp x sp).
+
+    The pipeline shard_map manualizes "sp" itself (vitax/parallel/pipeline.py
+    — a NESTED shard_map would hoist its closure constants into
+    manual-computation wrappers whose all-axes sharding encodings Shardy
+    rejects in jax 0.9), so this is the LOCAL all-to-all body called
+    directly in the already-manual region. With tp active (a GSPMD-auto axis
+    in the body), the inner full-sequence attention must be the dense einsum
+    path — GSPMD partitions it over the tp-global head dim; a Pallas kernel
+    cannot be auto-partitioned."""
+    inner = (reference_attention if (inner is None or with_tp) else inner)
+    return functools.partial(_ulysses_local, inner=inner,
+                             axis_name=axis_name)
