@@ -191,6 +191,15 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
         def one_block(carry, xs):
             layer_params, key = xs
             if layer_specs is not None and mesh.shape["fsdp"] > 1:
+                # pin the gathers to the loop iteration: the sharded layer
+                # slice alone is loop-invariant enough for XLA's LICM to
+                # hoist the per-block all-gathers out of the layer scan,
+                # materializing the whole STAGE's gathered parameters at
+                # once (28.7 GB vs 10.1 GB temps at the 10B flagship shape —
+                # caught by test_10b_shape_lowers_under_pipeline_fsdp). The
+                # barrier makes the gather input depend on the loop carry.
+                layer_params, carry = jax.lax.optimization_barrier(
+                    (layer_params, carry))
                 # ZeRO-3 inside the pipeline: gather this block's shards over
                 # "fsdp" just-in-time (under remat this sits inside the
                 # checkpointed region, so backward re-gathers rather than
