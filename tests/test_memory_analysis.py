@@ -395,7 +395,13 @@ def test_10b_shape_lowers_under_pipeline_fsdp(devices8):
     per stage, ZeRO-3 shards gathered just-in-time inside the GPipe body —
     vitax/parallel/pipeline.py), with the same per-device memory bet: the
     compiled arguments are one (pp x fsdp)-shard of the state, and temps
-    stay far below the whole 40.3 GB parameter tensor."""
+    stay far below the whole 40.3 GB parameter tensor. Guards the real
+    hazard this test caught: XLA LICM hoisting the per-block gathers out of
+    the layer scan, materializing the whole stage (28.7 GB vs 12.6 GB
+    temps). The 1F1B schedule is excluded: its vjp saves gathered layer
+    weights (~35 GB at this shape) and per-block remat there trips an
+    intermittent XLA abort — documented in pipeline_1f1b.py as a scale
+    limit (GPipe is the default)."""
     cfg = Config(image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
                  num_blocks=32, num_classes=1000, batch_size=8,
                  warmup_steps=0, pp_size=2, fsdp_size=4, dp_size=1,

@@ -49,6 +49,16 @@ TPU-first formulation, lockstep SPMD inside one `jax.shard_map`:
 
 v1 scope: dense blocks, no dropout (config.validate enforces both) — the
 schedule is the point; the GPipe body keeps those features.
+
+Known scale limit (measured at the 10.078B flagship shape, pp2 x fsdp4):
+`jax.vjp(stage_fwd)` saves every layer's GATHERED weights as scan
+residuals — ~35 GB of temps vs GPipe's 13 GB, because unlike the GPipe
+body the stage forward has no per-block jax.checkpoint (adding one
+triggers an intermittent XLA CPU compiler abort in this engine's
+vjp-inside-shard_map structure, so it stays out). At toy/L-scale shapes
+this is immaterial; at 10B-class shapes use the GPipe schedule (the
+default), whose just-in-time gather memory is asserted by
+tests/test_memory_analysis.py::test_10b_shape_lowers_under_pipeline_fsdp.
 """
 
 from __future__ import annotations
