@@ -317,9 +317,21 @@ def default_scan_unroll(preset: str, allow_tuned: bool = True) -> int:
 
 def default_remat_window(preset: str, allow_tuned: bool = True) -> int:
     """Per-preset remat window (the group-remat wgrad experiment): the
-    TUNED.json winner when measured, else 0 (per-block remat)."""
+    TUNED.json winner when measured, else the family fallback. The 10B
+    family keeps the none_saveable scan (it cannot unroll its residuals
+    away) and its single-chip slice measured +25% from window-2 group
+    remat (LADDER_r04.jsonl: 145.5 vs 116.3 img/s/chip) — the full
+    flagship preset inherits that measured family winner; everything else
+    defaults to per-block remat (0)."""
     t = _tuned(preset) if allow_tuned else {}
-    return int(t.get("remat_window", 0))
+    if "remat_window" in t:
+        return int(t["remat_window"])
+    # measured-winner class default, so it is gated on allow_tuned exactly
+    # like TUNED entries: with an explicit A/B knob pinning the others
+    # (allow_tuned=False), the window must fall back to 0 — a window-2
+    # default would contradict e.g. --no_grad_ckpt or --no_scan_blocks and
+    # trip validate() asserts the user never opted into
+    return 2 if (allow_tuned and preset.startswith("10b")) else 0
 
 
 def resolve_bench_knobs(scan_blocks, scan_unroll: int, remat_window: int,

@@ -88,10 +88,12 @@ def test_apply_ladder_picks_measured_winners(tmp_path, monkeypatch):
         {"args": "--preset b16 --no_scan_blocks",
          "result": {"value": 100.0,
                     "knobs": knobs(False, 1, 0, "dots_attn_saveable", 64)}},
-        # 10b_slice: a policy-only win must flip the policy along
+        # 10b_slice: a policy-only win must flip the policy along (the
+        # family code default is window-2 — LADDER_r04 — so the default
+        # and alternative rows both carry it)
         {"args": "--preset 10b_slice --remat_policy dots_saveable",
          "result": {"value": 130.0,
-                    "knobs": knobs(True, 1, 0, "dots_saveable", 64)}},
+                    "knobs": knobs(True, 1, 2, "dots_saveable", 64)}},
         # ignored rows: truncated, errored-with-positive-value, non-knob
         {"args": "--preset l14 --scan_unroll", "result": {"value": 999.0}},
         {"args": "--preset l14 --remat_window 16",
@@ -109,9 +111,9 @@ def test_apply_ladder_picks_measured_winners(tmp_path, monkeypatch):
         "b16": {"images_per_sec_chip": 99.0, "scan_blocks": True,
                 "scan_unroll": 1, "remat_window": 0,
                 "remat_policy": "dots_attn_saveable"},
-        # 10b_slice default (scan, none_saveable) measured at 116
+        # 10b_slice default (scan, window-2, none_saveable) measured at 116
         "10b_slice": {"images_per_sec_chip": 116.0, "scan_blocks": True,
-                      "scan_unroll": 1, "remat_window": 0,
+                      "scan_unroll": 1, "remat_window": 2,
                       "remat_policy": "none_saveable"},
         # tiny default measured — but tiny has no eligible ladder rows
         "tiny": {"images_per_sec_chip": 3827.0, "scan_blocks": True,
@@ -137,8 +139,9 @@ def test_apply_ladder_picks_measured_winners(tmp_path, monkeypatch):
     assert "b16" not in tuned
     # tiny: default measured, no alternatives -> no entry
     assert "tiny" not in tuned
-    # 10b_slice: the policy win rides into TUNED
+    # 10b_slice: the policy win rides into TUNED (window-2 rides along)
     assert tuned["10b_slice"]["remat_policy"] == "dots_saveable"
+    assert tuned["10b_slice"]["remat_window"] == 2
 
     # bench.py defaults consult TUNED.json
     assert bench.default_remat_window("l14") == 8
