@@ -65,6 +65,20 @@ def test_cross_topology_restore(devices8, tmp_path):
     qkv = restored.params["params"]["blocks"]["attn"]["qkv"]["kernel"]
     assert qkv.sharding.mesh.shape["fsdp"] == 4
 
+    # and onto a pp x tp mesh (round-4 composition): same param tree, the
+    # blocks' layer axis resharded over "pp" and Megatron dims over "tp"
+    cfg_c = tiny_cfg(ckpt_dir=str(tmp_path), pp_size=2, tp_size=2,
+                     dp_size=2, fsdp_size=1)
+    mesh_c, state_c, sspecs_c = make_state(cfg_c)
+    restored_c = restore_state(cfg_c.ckpt_dir, 3,
+                               abstract_of(state_c, mesh_c, sspecs_c))
+    for a, b in zip(jax.tree.leaves(state_a), jax.tree.leaves(restored_c)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    qkv_c = restored_c.params["params"]["blocks"]["attn"]["qkv"]["kernel"]
+    assert qkv_c.sharding.mesh.shape["pp"] == 2
+    assert "pp" in tuple(qkv_c.sharding.spec) and (
+        "tp" in tuple(qkv_c.sharding.spec))
+
 
 def test_resume_through_loop(devices8, tmp_path):
     """Train 2 epochs saving each; resume from epoch 1 and confirm the step
