@@ -130,25 +130,155 @@ def test_real_data_end_to_end(devices8, tmp_path):
 
 
 def test_att_dropout_kernel_bypass_warning(devices8, capsys):
-    """--att_dropout > 0 silently disables the fused kernel for training steps
-    (vitax/models/vit.py Attention.__call__ requires dropout==0 or
-    deterministic); make_attention_impl must warn loudly at startup. The
-    warning keys off config alone (use_flash_attention + att_dropout), so it
-    fires regardless of platform — a user's CPU smoke run sees it too."""
+    """The whole-N kernels run --att_dropout fused (round 5); only the
+    streaming kernel (N > MAX_SEQ_IN_VMEM) still bypasses to dense under
+    dropout, and make_attention_impl must warn loudly for exactly that case
+    — and NOT for the whole-N shapes, where the cliff is gone."""
     from vitax.config import Config
     from vitax.ops.attention import make_attention_impl
 
+    # whole-N shape with dropout: fused dropout variant, no warning
     cfg = Config(image_size=32, patch_size=16, embed_dim=32, num_heads=2,
                  num_blocks=1, att_dropout=0.1).validate()
-    make_attention_impl(cfg, mesh=None)
+    impl = make_attention_impl(cfg, mesh=None, force_tpu_kernels=True)
+    assert getattr(impl, "vitax_dropout", None) is not None
+    assert "WARNING" not in capsys.readouterr().out
+
+    # streaming shape (4096 tokens > MAX_SEQ_IN_VMEM): dense fallback, warn
+    cfg_s = Config(image_size=1024, patch_size=16, embed_dim=32, num_heads=2,
+                   num_blocks=1, att_dropout=0.1).validate()
+    make_attention_impl(cfg_s, mesh=None, force_tpu_kernels=True)
     out = capsys.readouterr().out
     assert "att_dropout" in out and "WARNING" in out and "dense" in out
+
+    # pipeline body has no dropout kernel either (vitax_pp_impl carries no
+    # vitax_dropout attribute): pp > 1 with dropout must warn too
+    cfg_pp = Config(image_size=32, patch_size=16, embed_dim=32, num_heads=2,
+                    num_blocks=2, pp_size=2, att_dropout=0.1).validate()
+    make_attention_impl(cfg_pp, mesh=None, force_tpu_kernels=True)
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "pipeline" in out
 
     # no warning at the reference default (att_dropout == 0)
     cfg0 = Config(image_size=32, patch_size=16, embed_dim=32, num_heads=2,
                   num_blocks=1, att_dropout=0.0).validate()
     make_attention_impl(cfg0, mesh=None)
     assert "WARNING" not in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# in-kernel attention dropout (vitax/ops/attention.py dropout variants)
+# ---------------------------------------------------------------------------
+
+def _dropout_oracle(q, k, v, seed, rate):
+    """Dense attention with the EXACT mask the kernels generate (the
+    counter-hash RNG is pure jnp, so the oracle shares its code path)."""
+    from vitax.ops.attention import dropout_keep_mask
+    b, n, h, dh = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * dh ** -0.5
+    probs = jax.nn.softmax(s, axis=-1)
+    mask = jnp.stack([jnp.stack([
+        dropout_keep_mask(seed, jnp.uint32(bi * h + hi), n, n, rate)
+        for hi in range(h)]) for bi in range(b)])    # (B, H, N, N)
+    a = (probs * mask / (1.0 - rate)).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", a, v)
+
+
+@pytest.mark.parametrize("family", ["4d", "bh"])
+def test_flash_dropout_matches_masked_dense(devices8, family):
+    """Kernel-path dropout == dense attention with the identical mask, for
+    outputs AND grads — both kernel families, real drops in play."""
+    from vitax.ops.attention import flash4_dropout, flash_bh_dropout, _to_bh, _from_bh
+
+    shape, rate = (2, 64, 2, 32), 0.35
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    seed = jnp.uint32(1234)
+    scale = shape[-1] ** -0.5
+
+    if family == "4d":
+        fn = lambda q, k, v: flash4_dropout(q, k, v, seed, scale, rate)  # noqa: E731
+    else:
+        fn = lambda q, k, v: _from_bh(flash_bh_dropout(  # noqa: E731
+            _to_bh(q), _to_bh(k), _to_bh(v), seed, scale, rate), q.shape)
+
+    out_k = fn(q, k, v)
+    out_d = _dropout_oracle(q, k, v, seed, rate)
+    # sanity: the mask actually dropped something (kernel != no-dropout path)
+    assert not np.allclose(np.asarray(out_k),
+                           np.asarray(reference_attention(q, k, v)), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    gk = jax.grad(loss(fn), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss(lambda q, k, v: _dropout_oracle(q, k, v, seed, rate)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_dropout_mask_statistics_and_determinism():
+    """Empirical drop rate ~ rate; same (seed, block) -> identical mask;
+    different seed or block index -> different mask; 4D's transposed layout
+    holds the same element decisions."""
+    from vitax.ops.attention import dropout_keep_mask
+
+    n, rate = 256, 0.3
+    seed = jnp.uint32(77)
+    m = dropout_keep_mask(seed, jnp.uint32(5), n, n, rate)
+    drop_frac = 1.0 - float(jnp.mean(m))
+    # binomial std at n^2 = 65536 draws: ~0.0018; allow 5 sigma
+    assert abs(drop_frac - rate) < 0.01, drop_frac
+    m2 = dropout_keep_mask(seed, jnp.uint32(5), n, n, rate)
+    assert np.array_equal(np.asarray(m), np.asarray(m2))
+    m3 = dropout_keep_mask(jnp.uint32(78), jnp.uint32(5), n, n, rate)
+    m4 = dropout_keep_mask(seed, jnp.uint32(6), n, n, rate)
+    assert not np.array_equal(np.asarray(m), np.asarray(m3))
+    assert not np.array_equal(np.asarray(m), np.asarray(m4))
+    mt = dropout_keep_mask(seed, jnp.uint32(5), n, n, rate, transposed=True)
+    assert np.array_equal(np.asarray(m), np.asarray(mt).T)
+
+
+def test_model_train_att_dropout_keeps_kernel_and_is_deterministic(devices8):
+    """Full model: --att_dropout > 0 training routes through the in-kernel
+    dropout variant (impl.vitax_dropout) and is reproducible given the same
+    dropout rng — nn.Dropout's determinism contract, now on the fused path."""
+    from vitax.config import Config
+    from vitax.models import build_model
+    from vitax.ops.attention import make_attention_impl
+
+    cfg = Config(image_size=32, patch_size=8, embed_dim=32, num_heads=2,
+                 num_blocks=2, num_classes=4, batch_size=8, dtype="float32",
+                 att_dropout=0.2).validate()
+    impl = make_attention_impl(cfg, mesh=None, force_tpu_kernels=True)
+    assert getattr(impl, "vitax_dropout", None) is not None
+    model = build_model(cfg, attention_impl=impl)
+    x = jax.random.normal(jax.random.key(4), (4, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.key(0), x, True)
+
+    rngs = {"dropout": jax.random.key(9)}
+    out1 = model.apply(params, x, False, rngs=rngs)
+    out2 = model.apply(params, x, False, rngs=rngs)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    out3 = model.apply(params, x, False, rngs={"dropout": jax.random.key(10)})
+    assert not np.array_equal(np.asarray(out1), np.asarray(out3))
+    # eval path (deterministic) unaffected by the dropout hook
+    out_eval = model.apply(params, x, True)
+    assert np.all(np.isfinite(np.asarray(out_eval)))
+
+    def loss_fn(p):
+        return jnp.sum(model.apply(p, x, False, rngs=rngs) ** 2)
+
+    grads = jax.grad(loss_fn)(params)
+    assert all(np.all(np.isfinite(np.asarray(g)))
+               for g in jax.tree_util.tree_leaves(grads))
 
 
 @pytest.mark.parametrize("shape", [(2, 64, 2, 32), (1, 128, 4, 16)])

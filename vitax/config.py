@@ -79,6 +79,7 @@ class Config:
     moe_capacity_factor: float = 1.25   # static expert capacity C = ceil(cf * tokens / experts)
     moe_top_k: int = 1                  # 1 = Switch (top-1); 2 = GShard-style top-2 with renormalized gates
     moe_aux_weight: float = 0.01        # load-balance aux loss weight (Switch Transformer)
+    moe_impl: str = "einsum"            # einsum (GShard one-hot — measured fastest on v5e) | gather (slot-index scatter + gathers; measured -23%, kept as the A/B arm)
     scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
     scan_unroll: int = 1                # blocks per scan step: >1 frees XLA to fuse across blocks
     #   (the scan's per-block dus-stacking constrains wgrad fusion layouts —
@@ -128,11 +129,10 @@ class Config:
             assert self.scan_unroll == 1, (
                 "--remat_window subsumes --scan_unroll (the window IS the "
                 "unrolled group); drop one of the two")
-            assert self.pp_size == 1 and self.moe_experts == 0 and (
-                max(self.pos_dropout, self.att_dropout,
-                    self.mlp_dropout) == 0.0), (
-                "--remat_window is the dense/deterministic wgrad experiment "
-                "(v1): no pp, MoE, or dropout")
+            assert self.pp_size == 1, (
+                "--remat_window composes with dropout and MoE (v2) but not "
+                "pp: the pipeline path owns checkpoint placement "
+                "(vitax/parallel/pipeline.py)")
         if self.pp_size > 1:
             assert self.scan_blocks, "--pp_size needs the stacked block tree (drop --no_scan_blocks)"
             assert self.reshard_after_forward or self.fsdp_size == 1, (
@@ -149,14 +149,15 @@ class Config:
             assert self.pp_microbatches >= 0
             assert self.pp_schedule in ("gpipe", "1f1b"), self.pp_schedule
             if self.moe_experts > 0:
-                assert self.ep_size == 1, (
-                    "--moe_experts under --pp_size > 1 needs experts "
-                    "replicated (--ep_size 1): expert sharding inside the "
-                    "manual pipeline body would need its own all-to-alls")
+                assert self.ep_size == 1 or self.moe_impl == "einsum", (
+                    "--moe_experts with --ep_size > 1 under --pp_size > 1 "
+                    "runs the manual all-to-all dispatch inside the pipeline "
+                    "body, which only the einsum impl implements "
+                    "(vitax/models/moe.py MoeMlp.ep_axis)")
                 assert self.tp_size == 1 and self.sp_size == 1, (
-                    "--moe_experts under --pp_size > 1 composes with dp/fsdp "
-                    "only: the MoE dispatch einsums inside the pipeline body "
-                    "are not exercised under auto-tp/sp meshes")
+                    "--moe_experts under --pp_size > 1 composes with "
+                    "dp/fsdp/ep only: the MoE dispatch einsums inside the "
+                    "pipeline body are not exercised under auto-tp/sp meshes")
             if self.pp_schedule == "1f1b":
                 assert max(self.pos_dropout, self.att_dropout,
                            self.mlp_dropout) == 0.0 and self.moe_experts == 0, (
@@ -174,6 +175,8 @@ class Config:
                 f"--moe_experts {self.moe_experts} not divisible by "
                 f"--ep_size {self.ep_size}")
         if self.moe_experts > 0:
+            assert self.moe_impl in ("gather", "einsum"), (
+                f"unknown moe_impl {self.moe_impl!r}")
             assert self.moe_top_k in (1, 2), self.moe_top_k
             assert self.moe_top_k <= self.moe_experts, (
                 f"--moe_top_k {self.moe_top_k} > --moe_experts "
@@ -239,6 +242,8 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--moe_capacity_factor", type=float, default=1.25)
     ext.add_argument("--moe_top_k", type=int, default=1, choices=[1, 2])
     ext.add_argument("--moe_aux_weight", type=float, default=0.01)
+    ext.add_argument("--moe_impl", type=str, default="einsum",
+                     choices=["gather", "einsum"])
     ext.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks")
     ext.add_argument("--scan_unroll", type=int, default=1)
     ext.add_argument("--remat_window", type=int, default=0)

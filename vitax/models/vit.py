@@ -120,8 +120,16 @@ class Attention(nn.Module):
             self.attention_impl is not None
             and (self.att_dropout == 0.0 or deterministic)
         )
+        drop_impl = getattr(self.attention_impl, "vitax_dropout", None)
         if use_kernel:
             out = self.attention_impl(q, k, v)  # (B, N, H, Dh)
+        elif drop_impl is not None:
+            # in-kernel attention dropout (vitax/ops/attention.py): the fused
+            # path survives --att_dropout > 0. Flax's per-block rng splitting
+            # (scan/pipeline) keys the mask: same (seed, step, layer) -> same
+            # mask, matching nn.Dropout's determinism contract
+            seed = jax.random.bits(self.make_rng("dropout"), (), jnp.uint32)
+            out = drop_impl(q, k, v, seed)
         else:
             scale = head_dim ** -0.5
             # accumulate logits in float32 on the MXU for stable softmax
@@ -192,6 +200,9 @@ class Block(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 1
+    moe_impl: str = "einsum"
+    moe_ep_axis: Optional[str] = None   # manual-ep (pipeline body) only
+    moe_ep_size: int = 1
     moe_dispatch_sharding: Optional[Any] = None
     token_sharding: Optional[Any] = None
 
@@ -235,6 +246,9 @@ class Block(nn.Module):
                 out_dim=d,
                 capacity_factor=self.moe_capacity_factor,
                 top_k=self.moe_top_k,
+                impl=self.moe_impl,
+                ep_axis=self.moe_ep_axis,
+                ep_size=self.moe_ep_size,
                 dtype=self.dtype,
                 dispatch_sharding=self.moe_dispatch_sharding,
                 token_sharding=self.token_sharding,
@@ -301,6 +315,9 @@ class VisionTransformer(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 1
+    moe_impl: str = "einsum"
+    moe_ep_axis: Optional[str] = None   # manual-ep (pipeline body) only
+    moe_ep_size: int = 1
     moe_dispatch_sharding: Optional[Any] = None
     # NamedSharding for (B, N, D) activations — anchors GSPMD batch sharding
     # and shards the token axis over "sp" for sequence parallelism
@@ -321,6 +338,9 @@ class VisionTransformer(nn.Module):
             moe_experts=self.moe_experts,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_top_k=self.moe_top_k,
+            moe_impl=self.moe_impl,
+            moe_ep_axis=self.moe_ep_axis,
+            moe_ep_size=self.moe_ep_size,
             moe_dispatch_sharding=self.moe_dispatch_sharding,
             token_sharding=self.token_sharding,
         )
@@ -434,35 +454,73 @@ def make_windowed_forward(cfg: Config, model: "VisionTransformer"):
     fusions freely — like --scan_unroll, plus group-level checkpoint
     placement. Consumes the SAME stacked (L, ...) param tree (reshaped in
     the compute graph only — init and checkpoints are unchanged).
-    Dense/deterministic v1 (config.validate)."""
+
+    v2 (round 5): composes with dropout (per-layer keys split from the step
+    rng ride the scan as xs — same (seed, step) -> same masks, matching
+    nn.Dropout's determinism contract) and with MoE (per-layer sown aux
+    ingredients become scan ys, combined by aux_from_frac_prob exactly like
+    the nn.scan path). pp remains excluded (config.validate; the pipeline
+    path owns checkpoint placement there)."""
     w = cfg.remat_window
     groups = cfg.num_blocks // w
     block = Block(**model.block_kwargs())  # keeps the activation anchors
     policy = _REMAT_POLICIES[cfg.remat_policy]
     dtype = model.dtype
+    moe = cfg.moe_experts > 0
+    has_block_dropout = cfg.att_dropout > 0 or cfg.mlp_dropout > 0
 
     def forward(params, images, det: bool = True, rng=None,
                 with_aux: bool = False):
-        del rng
-        assert det and not with_aux, (
-            "windowed forward is dense/deterministic (config.validate)")
+        assert det or rng is not None, "training under dropout needs rng"
         p = params["params"]
         x = apply_embed(p, images, patch_size=cfg.patch_size,
                         embed_dim=cfg.embed_dim, dtype=dtype)
+        if not det and cfg.pos_dropout > 0:
+            pos_rng, rng = jax.random.split(rng)
+            keep = jax.random.bernoulli(pos_rng, 1.0 - cfg.pos_dropout,
+                                        x.shape)
+            x = jnp.where(keep, x / (1.0 - cfg.pos_dropout),
+                          jnp.zeros((), x.dtype))
         if model.token_sharding is not None:
             x = jax.lax.with_sharding_constraint(x, model.token_sharding)
         grouped = jax.tree.map(
             lambda l: l.reshape(groups, w, *l.shape[1:]), p["blocks"])
+        use_keys = not det and has_block_dropout
+        keys = (jax.random.split(rng, cfg.num_blocks).reshape(groups, w)
+                if use_keys else None)
 
-        def apply_group(carry, gparams):
+        def apply_group(carry, gparams, gkeys):
+            aux = []
             for i in range(w):
                 layer = jax.tree.map(lambda g: g[i], gparams)
-                carry = block.apply({"params": layer}, carry, True)
-            return carry
+                rngs = {"dropout": gkeys[i]} if use_keys else None
+                if moe and with_aux:
+                    carry, cols = block.apply(
+                        {"params": layer}, carry, det, rngs=rngs,
+                        mutable=["intermediates"])
+                    m = cols["intermediates"]["moe"]
+                    aux.append((m["moe_frac_tokens"][0],
+                                m["moe_mean_prob"][0]))
+                else:
+                    carry = block.apply({"params": layer}, carry, det,
+                                        rngs=rngs)
+            if not aux:
+                return carry, None
+            return carry, (jnp.stack([a[0] for a in aux]),
+                           jnp.stack([a[1] for a in aux]))  # (w, E) each
 
-        body = jax.checkpoint(apply_group, policy=policy, prevent_cse=False)
-        x, _ = jax.lax.scan(lambda c, gp: (body(c, gp), None), x, grouped)
-        return apply_tail(p, x, num_classes=cfg.num_classes, dtype=dtype)
+        body = jax.checkpoint(apply_group, policy=policy, prevent_cse=False,
+                              static_argnums=())
+        xs = (grouped, keys) if use_keys else (grouped,)
+        x, aux_stacks = jax.lax.scan(
+            lambda c, gx: body(c, *gx, *(() if use_keys else (None,))),
+            x, xs)
+        logits = apply_tail(p, x, num_classes=cfg.num_classes, dtype=dtype)
+        if not with_aux:
+            return logits
+        from vitax.train.step import aux_from_frac_prob
+        fracs, probs = aux_stacks  # (groups, w, E) each
+        return logits, aux_from_frac_prob([fracs], [probs], cfg)
 
     return forward
 
@@ -492,6 +550,7 @@ def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
         moe_experts=cfg.moe_experts,
         moe_capacity_factor=cfg.moe_capacity_factor,
         moe_top_k=cfg.moe_top_k,
+        moe_impl=cfg.moe_impl,
         moe_dispatch_sharding=moe_dispatch_sharding,
         token_sharding=token_sharding,
     )

@@ -36,8 +36,13 @@ from vitax.ops.attention import _interpret
 
 NEG_INF = -1e30  # large-but-finite: avoids inf-inf=nan in max/exp chains
 
+"""Measured block defaults (round-5 ladder, tools/long_context_ladder.py ->
+LADDER_LONGCTX.jsonl, v5e, ViT-L width train steps): the (512, 1024) pair
+wins at N=4,096 (79.3 ms vs 102.8 at the untuned (512, 512)) and is within
+5% of best at N=9,216 (295.9 vs 280.2 at (1024, 1024)). A taller K block
+amortizes the online-softmax rescale chain over more of the KV stream."""
 DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_K = 1024
 
 
 def _col_mask(n_valid_ref, j, bk, s):
