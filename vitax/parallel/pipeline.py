@@ -42,7 +42,8 @@ placements on their weight dims in addition to "pp" on the layer dim, and
   the weights' own Megatron placements exactly as on the scan path.
   Attention under tp uses the dense einsum path (GSPMD shards it over the
   tp-global head dim; a Pallas kernel cannot be auto-partitioned — at ViT
-  sequence lengths attention is a few percent of block FLOPs).
+  sequence lengths the dense path measured ~1.9% of step time at 10B
+  dims on v5e — BASELINE.md round-5 attention A/B).
 Inside the pipeline body each block's leaves are all-gathered
 over "fsdp" right before use — the manual form of the per-block gather
 GSPMD emits on the scan path — and autodiff's transpose of that gather is
@@ -59,12 +60,19 @@ v2 additions over the original GPipe body:
   from the step rng inside the body, so masks are deterministic given
   (seed, step) and distinct across microbatches, layers, and batch shards.
   Position dropout applies outside the shard_map (plain GSPMD).
-- MoE blocks work under pp (with experts replicated, --ep_size 1; expert
-  sharding inside the manual pipeline would need its own all-to-alls): each
-  block's sown load-balance ingredients (frac_tokens, mean_prob — LINEAR in
-  the tokens) are masked on bubble ticks, averaged over microbatches and
-  data shards, and only then combined into the nonlinear Switch aux product
-  — so the pipeline's aux equals the scan path's exactly.
+- MoE blocks work under pp: each block's sown load-balance ingredients
+  (frac_tokens, mean_prob — LINEAR in the tokens) are masked on bubble
+  ticks, averaged over microbatches and data shards, and only then combined
+  into the nonlinear Switch aux product — so the pipeline's aux equals the
+  scan path's exactly.
+
+v3 (round 5): expert parallelism composes too (--ep_size > 1 with
+--pp_size > 1): "ep" is already a manual axis of the pipeline shard_map, so
+the MoeMlp runs its own tiled all_to_all pair over it and declares expert
+params at the local (E/ep, ...) shard shape (vitax/models/moe.py MoeMlp
+.ep_axis/.ep_size) — the hand-written form of the batch<->expert exchange
+GSPMD derives from dispatch_sharding on the scan path. einsum impl only
+(config.validate).
 """
 
 from __future__ import annotations
@@ -121,9 +129,6 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
         f"batch {cfg.batch_size} must divide by data-axes*microbatches "
         f"({dp_like}*{M})")
     moe = cfg.moe_experts > 0
-    if moe:
-        assert mesh.shape["ep"] == 1, (
-            "MoE under pp needs experts replicated (--ep_size 1)")
     # tp present: partial-manual shard_map (tp stays GSPMD-auto) with vma
     # tracking (see the shard_map call below); absent: full-manual,
     # round-3 behavior. sp is ALWAYS manual: the ring/ulysses bodies run
@@ -131,9 +136,10 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     tp_auto = mesh.shape["tp"] > 1
     if (tp_auto and cfg.dtype == "bfloat16"
             and jax.devices()[0].platform == "cpu"):
-        from vitax.utils.logging import master_print
-        master_print(
-            "WARNING: pp x tp with bf16 on the CPU backend crashes XLA's "
+        # a warning here would be followed by a native XLA abort the user
+        # can't connect back to it (ADVICE r4) — fail loudly instead
+        raise ValueError(
+            "pp x tp with bf16 on the CPU backend crashes XLA's "
             "operand_upcaster pass (CPU bf16-dot emulation mishandles "
             "partitioner-generated copies in the pipeline's scan loops). "
             "This pass does not exist in TPU's native-bf16 compile "
@@ -174,6 +180,14 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     # inside shard_map (and NamedSharding constraints are illegal there)
     bk["token_sharding"] = None
     bk["moe_dispatch_sharding"] = None
+    if moe and mesh.shape["ep"] > 1:
+        # expert parallelism inside the manual body: the MoeMlp runs its own
+        # tiled all_to_all pair over the in-scope "ep" axis and declares its
+        # expert params at the local (E/ep, ...) shard shape — the manual
+        # form of the a2a GSPMD derives from dispatch_sharding on the scan
+        # path (vitax/models/moe.py)
+        bk["moe_ep_axis"] = "ep"
+        bk["moe_ep_size"] = mesh.shape["ep"]
     block = Block(**bk)
 
     # manual-axis view of the block specs: tp placements are stripped when

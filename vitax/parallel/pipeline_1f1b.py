@@ -50,15 +50,17 @@ TPU-first formulation, lockstep SPMD inside one `jax.shard_map`:
 v1 scope: dense blocks, no dropout (config.validate enforces both) — the
 schedule is the point; the GPipe body keeps those features.
 
-Known scale limit (measured at the 10.078B flagship shape, pp2 x fsdp4):
-`jax.vjp(stage_fwd)` saves every layer's GATHERED weights as scan
-residuals — ~35 GB of temps vs GPipe's 13 GB, because unlike the GPipe
-body the stage forward has no per-block jax.checkpoint (adding one
-triggers an intermittent XLA CPU compiler abort in this engine's
-vjp-inside-shard_map structure, so it stays out). At toy/L-scale shapes
-this is immaterial; at 10B-class shapes use the GPipe schedule (the
-default), whose just-in-time gather memory is asserted by
-tests/test_memory_analysis.py::test_10b_shape_lowers_under_pipeline_fsdp.
+Scale limit, PER BACKEND (round 5 update of the round-4 note): on TPU the
+stage forward remats per block (`_remat_blocks`), so `jax.vjp(stage_fwd)`
+saves one (mb, N, D) carry per layer and re-runs the ZeRO-3 gathers in the
+backward — GPipe's just-in-time memory semantics at the 10B shape (proven
+by AOT-compiling this engine against a v5p topology,
+tools/aot_topology.py --configs 10b_1f1b / AOT_TOPOLOGY.json). On the CPU
+backend the per-block checkpoint stays OUT: the jax-0.9 CPU compiler
+intermittently aborts on the rematted vjp-inside-shard_map structure
+(re-reproduced round 5, ~1-in-3 across repeated 1f1b test runs), so CPU
+compiles save gathered layer weights (~35 GB at the 10B pp2 x fsdp4
+shape) — immaterial at the toy shapes CPU actually runs.
 """
 
 from __future__ import annotations
@@ -72,6 +74,13 @@ from vitax.parallel.mesh import BATCH_AXES
 from vitax.parallel.pipeline import _gather_over
 
 import optax
+
+
+def _remat_blocks(mesh: Mesh) -> bool:
+    """Whether the 1F1B stage forward remats per block — decided by the
+    COMPILE TARGET's platform (see the stage_fwd comment: the CPU XLA
+    backend intermittently aborts on the rematted engine; TPU compiles it)."""
+    return next(iter(mesh.devices.flat)).platform == "tpu"
 
 
 def make_1f1b_value_and_grad(cfg: Config, model, mesh: Mesh, state_specs):
@@ -113,10 +122,31 @@ def make_1f1b_value_and_grad(cfg: Config, model, mesh: Mesh, state_specs):
     def stage_fwd(stage_params, x):
         def one_block(carry, layer_params):
             if mesh.shape["fsdp"] > 1:
+                # pin the gather inside the (rematted) scan iteration: XLA
+                # LICM otherwise hoists loop-invariant all-gathers out of
+                # the loop, materializing every layer's gathered weights at
+                # once (the GPipe body's idiom, vitax/parallel/pipeline.py)
+                layer_params, carry = jax.lax.optimization_barrier(
+                    (layer_params, carry))
                 layer_params = jax.tree.map(
                     lambda s, p: _gather_over(p, s, "fsdp"),
                     layer_specs, layer_params, is_leaf=is_spec)
             return block.apply({"params": layer_params}, carry, True), None
+        # per-block checkpoint, TPU ONLY (round 5): jax.vjp(stage_fwd)
+        # otherwise saves every layer's GATHERED weights as scan residuals
+        # (~35 GB at the 10B pp2 x fsdp4 shape vs GPipe's 13 GB). With the
+        # block rematted, the residual is one (mb, N, D) carry per layer and
+        # the gather re-runs in the backward — GPipe's just-in-time
+        # semantics. The gate is the COMPILE TARGET (mesh devices), not the
+        # host: the round-4 intermittent XLA abort re-reproduced under jax
+        # 0.9 on the CPU backend (1-in-~3 across repeated
+        # tests/test_pipeline.py 1f1b runs — a CPU-compiler bug on this
+        # engine's vjp-in-shard_map structure), while the TPU compiler
+        # handles it (proven by AOT-compiling this engine at the 10B shape
+        # against a v5p topology: tools/aot_topology.py --configs 10b_1f1b,
+        # AOT_TOPOLOGY.json temp bytes ~ GPipe level).
+        if _remat_blocks(mesh):
+            one_block = jax.checkpoint(one_block, prevent_cse=False)
         y, _ = jax.lax.scan(one_block, x, stage_params,
                             unroll=min(cfg.scan_unroll, Lps))
         return y
