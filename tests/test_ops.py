@@ -130,10 +130,10 @@ def test_real_data_end_to_end(devices8, tmp_path):
 
 
 def test_att_dropout_kernel_bypass_warning(devices8, capsys):
-    """The whole-N kernels run --att_dropout fused (round 5); only the
-    streaming kernel (N > MAX_SEQ_IN_VMEM) still bypasses to dense under
-    dropout, and make_attention_impl must warn loudly for exactly that case
-    — and NOT for the whole-N shapes, where the cliff is gone."""
+    """--att_dropout runs fused on the whole-N AND streaming kernels (round
+    5); only sp and pp-under-tp still bypass to dense under dropout, and
+    make_attention_impl must warn loudly for exactly those cases — and NOT
+    where the cliff is gone."""
     from vitax.config import Config
     from vitax.ops.attention import make_attention_impl
 
@@ -144,18 +144,21 @@ def test_att_dropout_kernel_bypass_warning(devices8, capsys):
     assert getattr(impl, "vitax_dropout", None) is not None
     assert "WARNING" not in capsys.readouterr().out
 
-    # streaming shape (4096 tokens > MAX_SEQ_IN_VMEM): dense fallback, warn
+    # streaming shape (4096 tokens > MAX_SEQ_IN_VMEM): fused too (round 5)
     cfg_s = Config(image_size=1024, patch_size=16, embed_dim=32, num_heads=2,
                    num_blocks=1, att_dropout=0.1).validate()
-    make_attention_impl(cfg_s, mesh=None, force_tpu_kernels=True)
-    out = capsys.readouterr().out
-    assert "att_dropout" in out and "WARNING" in out and "dense" in out
+    impl_s = make_attention_impl(cfg_s, mesh=None, force_tpu_kernels=True)
+    assert getattr(impl_s, "vitax_dropout", None) is not None
+    assert "WARNING" not in capsys.readouterr().out
 
-    # pipeline body has no dropout kernel either (vitax_pp_impl carries no
-    # vitax_dropout attribute): pp > 1 with dropout must warn too
+    # pipeline body under tp has no dropout kernel (vitax_pp_impl is None
+    # there — dense einsum path): pp x tp with dropout must warn
+    from vitax.parallel.mesh import build_mesh
     cfg_pp = Config(image_size=32, patch_size=16, embed_dim=32, num_heads=2,
-                    num_blocks=2, pp_size=2, att_dropout=0.1).validate()
-    make_attention_impl(cfg_pp, mesh=None, force_tpu_kernels=True)
+                    num_blocks=2, pp_size=2, tp_size=2, dp_size=2,
+                    att_dropout=0.1).validate()
+    make_attention_impl(cfg_pp, build_mesh(cfg_pp),
+                        force_tpu_kernels=True)
     out = capsys.readouterr().out
     assert "WARNING" in out and "pipeline" in out
 

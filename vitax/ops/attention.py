@@ -84,17 +84,21 @@ def _fmix32(x):
 
 
 def dropout_keep_mask(seed, bh_index, nq: int, nk: int, rate: float,
-                      transposed: bool = False):
+                      transposed: bool = False, q0=0, k0=0):
     """f32 {0, 1} keep-mask for one (head, batch) score block.
 
     seed: traced uint32 scalar; bh_index: uint32 scalar identifying the
     global (batch, head) pair; transposed=True yields the (Nk, Nq) layout the
     4D kernel's transposed-score space uses — the SAME element decisions,
-    so 4D and BH kernels drop identical (q, k) positions."""
+    so 4D and BH kernels drop identical (q, k) positions. q0/k0 offset the
+    row/col indices to GLOBAL positions (may be traced scalars) — the
+    streaming kernel's (q-block, k-block) tiles reproduce exactly the
+    decisions the whole-(N, N) mask makes at those coordinates, which is
+    what lets its bwd tiles regenerate the fwd's mask."""
     shape = (nk, nq) if transposed else (nq, nk)
     qdim, kdim = (1, 0) if transposed else (0, 1)
-    qi = jax.lax.broadcasted_iota(jnp.uint32, shape, qdim)
-    kj = jax.lax.broadcasted_iota(jnp.uint32, shape, kdim)
+    qi = jax.lax.broadcasted_iota(jnp.uint32, shape, qdim) + jnp.uint32(q0)
+    kj = jax.lax.broadcasted_iota(jnp.uint32, shape, kdim) + jnp.uint32(k0)
     x = (qi * jnp.uint32(_GOLD_Q) + kj * jnp.uint32(_GOLD_K)
          + bh_index.astype(jnp.uint32) * jnp.uint32(_GOLD_BH))
     bits = _fmix32(_fmix32(x ^ seed.astype(jnp.uint32)))
@@ -724,8 +728,8 @@ flash4_dropout.defvjp(_flash4_drop_fwd, _flash4_drop_bwd)
 def _tpu_dropout_kernel(cfg, n: int, force: bool = False,
                         local_heads: int = 0):
     """fn(q4, k4, v4, seed) -> o4 with in-kernel attention dropout at
-    cfg.att_dropout, or None when the selected path has no dropout variant
-    (streaming kernel; kernels disabled; off-TPU without force)."""
+    cfg.att_dropout (whole-N 4D/BH or streaming by shape), or None when
+    kernels are disabled / off-TPU without force."""
     if not cfg.use_flash_attention or cfg.att_dropout <= 0.0:
         return None
     if not force and jax.devices()[0].platform != "tpu":
@@ -745,7 +749,13 @@ def _tpu_dropout_kernel(cfg, n: int, force: bool = False,
                                  q.shape[-1] ** -0.5, rate)
             return _from_bh(o, q.shape)
         return dropbh
-    return None  # streaming: no dropout variant (falls back to dense)
+    # streaming: the blocked kernels regenerate the same counter-hash mask
+    # at global tile coordinates (vitax/ops/flash_blocked.py, round 5)
+    from vitax.ops.flash_blocked import blocked_dropout_attention
+
+    def dropstream(q, k, v, seed):
+        return blocked_dropout_attention(q, k, v, seed, rate)
+    return dropstream
 
 
 def _select_path(n: int, h: int, dh: int, itemsize: int) -> str:
@@ -850,10 +860,11 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
     force_tpu_kernels=True makes the same selections off-TPU with the Pallas
     kernels in interpret mode (the multichip dryrun's production-path sweep).
 
-    Attention dropout: the whole-N kernels carry an in-kernel dropout variant
-    (exposed as impl.vitax_dropout, taking (q, k, v, seed)); the Block uses
-    it for training steps, so --att_dropout > 0 keeps the fused path. Only
-    the streaming kernel (N > MAX_SEQ_IN_VMEM) and the sp paths still fall
+    Attention dropout: the whole-N AND streaming kernels carry an in-kernel
+    dropout variant (exposed as impl.vitax_dropout, taking (q, k, v, seed));
+    the Block uses it for training steps, so --att_dropout > 0 keeps the
+    fused path, including inside the pipeline body (the raw kernel rides
+    vitax_local_impl there). Only the sp paths and pp-under-tp still fall
     back to dense under dropout — warned below when that applies.
     """
     n = cfg.num_patches
@@ -862,22 +873,25 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
 
     if cfg.use_flash_attention and cfg.att_dropout > 0.0:
-        h_local = cfg.num_heads // max(tp, 1)
-        dh = cfg.embed_dim // cfg.num_heads
-        itemsize = 2 if cfg.dtype == "bfloat16" else 4
         pp = getattr(cfg, "pp_size", 1)
-        if (sp > 1 or pp > 1
-                or _select_path(n, h_local, dh, itemsize) == "streaming"):
-            which = ("sequence parallelism" if sp > 1
-                     else "the pipeline body" if pp > 1
-                     else "the streaming kernel")
+        if sp > 1 or (pp > 1 and tp > 1):
             from vitax.utils.logging import master_print
+            if sp > 1:
+                detail = ("sequence parallelism has no in-kernel dropout "
+                          "variant — training falls back to the dense "
+                          "O(N^2) attention path; eval still uses the "
+                          "kernel.")
+            else:
+                detail = ("the pipeline body under tp runs the dense "
+                          "einsum path for BOTH train and eval (a Pallas "
+                          "kernel cannot ride a GSPMD-auto axis), so "
+                          "dropout adds no further cliff there — but it "
+                          "is not fused either.")
             master_print(
-                f"WARNING: --att_dropout {cfg.att_dropout} > 0 with "
-                f"{which} has no in-kernel dropout variant — training falls "
-                f"back to the dense O(N^2) attention path; eval still uses "
-                f"the kernel. The whole-N kernels (N <= {MAX_SEQ_IN_VMEM}, "
-                f"sp=1, pp=1) run dropout fused.")
+                f"WARNING: --att_dropout {cfg.att_dropout} > 0: {detail} "
+                f"The whole-N and streaming kernels (sp=1; pp without tp "
+                f"included — the body seeds per-shard keys) run dropout "
+                f"fused.")
 
     if sp > 1:
         if n % sp != 0 or cfg.num_heads % tp != 0:
@@ -932,6 +946,10 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
         impl = _named(kernel, name)
         if drop_kernel is not None:
             impl.vitax_dropout = drop_kernel
+            # single-device impls also serve as the pipeline BODY impl
+            # (vitax_local_impl path below is only built for mesh > 1);
+            # inside the body the per-(tick, layer, shard) flax keys already
+            # decorrelate masks, so the raw kernel applies as-is
         return impl
     spec = P(BATCH_AXES, None, "tp", None)  # (B, N, H, Dh)
     wrapped = _named(jax.shard_map(
@@ -965,6 +983,12 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
     # (vitax_local_impl). Under tp > 1 no kernel variant is usable in the
     # body — vitax_pp_impl is explicitly None there (see below).
     wrapped.vitax_local_impl = _named(kernel, name)
+    if drop_kernel is not None:
+        # the RAW dropout kernel (no shard-index seed fold): inside the
+        # pipeline body each (tick, layer, data-shard) draws its own flax
+        # key (vitax/parallel/pipeline.py), so masks are already
+        # decorrelated across shards — pp keeps the fused dropout path
+        wrapped.vitax_local_impl.vitax_dropout = drop_kernel
     if mesh.shape.get("tp", 1) > 1:
         # pp body under tp: "tp" is a GSPMD-auto axis there and a Pallas
         # kernel cannot be auto-partitioned (and a nested tp shard_map hits
