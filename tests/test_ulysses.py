@@ -87,3 +87,50 @@ def test_ulysses_train_step_equivalence(devices8):
     _, losses_base = run_steps(cfg_base, n_steps=4)
     assert all(np.isfinite(losses_sp))
     np.testing.assert_allclose(losses_sp, losses_base, rtol=2e-4)
+
+
+def test_ulysses_dropout_matches_masked_dense(devices8):
+    """Ulysses in-kernel dropout (round 5): the resharded inner kernel drops
+    with the shared counter-hash on its full-sequence head slice, seeded per
+    shard. The oracle reconstructs the exact per-(shard, local-block) masks
+    from the a2a layout (shard s holds heads [s*H/sp, (s+1)*H/sp)), so this
+    also pins the head-slice ordering the seed-fold assumes."""
+    from vitax.ops.attention import (_GOLD_BH, _fmix32, dropout_keep_mask,
+                                     make_attention_impl)
+
+    cfg = sp_cfg(sp_size=2, fsdp_size=1, att_dropout=0.25)
+    mesh = build_mesh(cfg, devices=jax.devices()[:2])  # sp2 only
+    impl = make_attention_impl(cfg, mesh, force_tpu_kernels=True)
+    drop = getattr(impl, "vitax_dropout", None)
+    assert drop is not None
+
+    b, n, h, dh = 4, cfg.num_patches, cfg.num_heads, 8
+    h_loc = h // 2
+    rng_k = jax.random.split(jax.random.key(3), 3)
+    q, k, v = (jax.random.normal(kk, (b, n, h, dh), jnp.float32)
+               for kk in rng_k)
+    seed, rate = jnp.uint32(17), cfg.att_dropout
+
+    out = jax.jit(lambda q, k, v: drop(q, k, v, seed))(q, k, v)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * dh ** -0.5
+    probs = jax.nn.softmax(s, axis=-1)
+    masks = []
+    for g in range(h):
+        shard, hl = g // h_loc, g % h_loc
+        seed_s = seed ^ _fmix32(jnp.uint32(shard) * jnp.uint32(_GOLD_BH))
+        masks.append(jnp.stack([
+            dropout_keep_mask(seed_s, jnp.uint32(bi * h_loc + hl), n, n,
+                              rate) for bi in range(b)]))
+    mask = jnp.stack(masks, axis=1)                      # (B, H, N, N)
+    want = jnp.einsum("bhqk,bkhd->bqhd", probs * mask / (1 - rate), v)
+
+    assert not np.allclose(np.asarray(out),
+                           np.asarray(reference_attention(q, k, v)),
+                           atol=1e-3)  # dropout actually bit
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    # determinism given the seed
+    out2 = jax.jit(lambda q, k, v: drop(q, k, v, seed))(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))

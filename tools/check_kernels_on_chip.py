@@ -90,6 +90,39 @@ def main():
     # streaming blocked kernel (long-sequence path)
     ok &= check("streaming blocked (n4096)", blocked_flash_attention,
                 reference_attention, (1, 4096, 4, 64))
+
+    # dropout variants (round 5): the dense comparator shares the
+    # counter-hash mask code, so these check Mosaic's lowering of the
+    # uint32 hash + masked-softmax math on real hardware, fwd and bwd
+    from vitax.ops.attention import (dropout_keep_mask, flash4_dropout,
+                                     flash_bh_dropout, _to_bh, _from_bh)
+    from vitax.ops.flash_blocked import blocked_dropout_attention
+    seed32, rate = jnp.uint32(2024), 0.2
+
+    def dense_masked(q, k, v):
+        b, n, h, dh = q.shape
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * dh ** -0.5
+        probs = jax.nn.softmax(s, axis=-1)
+        mask = jnp.stack([jnp.stack([
+            dropout_keep_mask(seed32, jnp.uint32(bi * h + hi), n, n, rate)
+            for hi in range(h)]) for bi in range(b)])
+        return jnp.einsum("bhqk,bkhd->bqhd",
+                          (probs * mask / (1 - rate)).astype(q.dtype), v)
+
+    ok &= check("4D dropout (l14 geometry)",
+                lambda q, k, v: flash4_dropout(
+                    q, k, v, seed32, q.shape[-1] ** -0.5, rate),
+                dense_masked, (4, 256, 16, 64))
+    ok &= check("BH dropout (h8 dh64)",
+                lambda q, k, v: _from_bh(flash_bh_dropout(
+                    _to_bh(q), _to_bh(k), _to_bh(v), seed32,
+                    q.shape[-1] ** -0.5, rate), q.shape),
+                dense_masked, (2, 256, 8, 64))
+    ok &= check("streaming dropout (n4096)",
+                lambda q, k, v: blocked_dropout_attention(
+                    q, k, v, seed32, rate),
+                dense_masked, (1, 4096, 4, 64))
     print("ON-CHIP KERNEL NUMERICS:", "OK" if ok else "FAILED")
     return 0 if ok else 1
 

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 end-of-round chip sequence: waits for the axon tunnel to return,
+# then (1) validates every kernel family incl. the round-5 dropout variants
+# on hardware, (2) re-measures every bench preset at HEAD with
+# --write_baseline (the scoreboard contract: BENCH_r05 must reflect round-5
+# code, VERDICT r4 item 10), (3) takes the e2e feed+train number, and
+# (4) probes the tiny preset's batch sensitivity. Logs to WATCHER_R05.log.
+set -u
+cd "$(dirname "$0")/.."
+LOG=WATCHER_R05.log
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$LOG"; }
+
+log "watcher started; probing for the chip"
+until timeout 120 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; do
+  log "chip still down; retrying in 120s"
+  sleep 120
+done
+log "chip is UP — running the sequence"
+
+log "=== check_kernels_on_chip (incl. dropout variants)"
+timeout 900 python tools/check_kernels_on_chip.py >> "$LOG" 2>&1
+log "kernel check rc=$?"
+
+for preset in tiny b16 b16_moe l14 10b_slice; do
+  log "=== bench --preset $preset --write_baseline"
+  timeout 900 python bench.py --preset "$preset" --write_baseline 2>>"$LOG" \
+    | tail -1 >> "$LOG"
+done
+
+log "=== bench --preset data / data_scaling (feed ratios vs fresh numbers)"
+timeout 900 python bench.py --preset data --write_baseline 2>>"$LOG" | tail -1 >> "$LOG"
+timeout 900 python bench.py --preset data_scaling --write_baseline 2>>"$LOG" | tail -1 >> "$LOG"
+
+log "=== bench --preset e2e (10b_slice feed+train, overlap)"
+timeout 1800 python bench.py --preset e2e --write_baseline 2>>"$LOG" | tail -1 >> "$LOG"
+
+log "=== e2e feed-limited arms (l14/b16 on a 1-core host — honest input-bound numbers)"
+timeout 1800 python bench.py --preset e2e --e2e_train_preset l14 2>>"$LOG" | tail -1 >> "$LOG"
+timeout 1800 python bench.py --preset e2e --e2e_train_preset b16 2>>"$LOG" | tail -1 >> "$LOG"
+
+log "=== tiny batch probe (128, 256 — fixed-overhead amortization)"
+timeout 900 python bench.py --preset tiny --batch_size 128 2>>"$LOG" | tail -1 >> "$LOG"
+timeout 900 python bench.py --preset tiny --batch_size 256 2>>"$LOG" | tail -1 >> "$LOG"
+
+log "=== l14 att_dropout arm at HEAD (in-kernel path)"
+timeout 900 python bench.py --preset l14 --att_dropout 0.1 2>>"$LOG" | tail -1 >> "$LOG"
+
+log "sequence DONE"

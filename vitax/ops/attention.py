@@ -83,6 +83,22 @@ def _fmix32(x):
     return x
 
 
+def fold_shard_seed(mesh, axes, seed):
+    """Fold the linearized shard position over `axes` into a dropout seed.
+
+    Inside shard_map every shard sees the same LOCAL (batch, head) block
+    indices, so without this two shards would draw identical masks; the
+    fold gives each a decorrelated stream while staying deterministic given
+    (seed, step). Shared by the shard_map dropout wrappers here and in
+    vitax/parallel/ulysses.py — the mask-reproducibility contract (bwd
+    regenerates the fwd's mask) requires exactly one fold idiom."""
+    idx = jnp.uint32(0)
+    for ax in axes:
+        idx = (idx * jnp.uint32(mesh.shape[ax])
+               + jax.lax.axis_index(ax).astype(jnp.uint32))
+    return seed ^ _fmix32(idx * jnp.uint32(_GOLD_BH))
+
+
 def dropout_keep_mask(seed, bh_index, nq: int, nk: int, rate: float,
                       transposed: bool = False, q0=0, k0=0):
     """f32 {0, 1} keep-mask for one (head, batch) score block.
@@ -874,24 +890,28 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
 
     if cfg.use_flash_attention and cfg.att_dropout > 0.0:
         pp = getattr(cfg, "pp_size", 1)
-        if sp > 1 or (pp > 1 and tp > 1):
+        ulysses_drop_ok = (getattr(cfg, "sp_impl", "ring") == "ulysses"
+                           and cfg.num_heads % max(sp * tp, 1) == 0)
+        details = []  # each applicable cause gets its own sentence
+        if sp > 1 and not ulysses_drop_ok:
+            details.append(
+                "ring sequence parallelism has no in-kernel dropout "
+                "variant (--sp_impl ulysses carries one) — training falls "
+                "back to the dense O(N^2) attention path; eval still uses "
+                "the kernel")
+        if pp > 1 and tp > 1:
+            details.append(
+                "the pipeline body under tp runs the dense einsum path "
+                "for BOTH train and eval (a Pallas kernel cannot ride a "
+                "GSPMD-auto axis), so dropout adds no further cliff there "
+                "— but it is not fused either")
+        if details:
             from vitax.utils.logging import master_print
-            if sp > 1:
-                detail = ("sequence parallelism has no in-kernel dropout "
-                          "variant — training falls back to the dense "
-                          "O(N^2) attention path; eval still uses the "
-                          "kernel.")
-            else:
-                detail = ("the pipeline body under tp runs the dense "
-                          "einsum path for BOTH train and eval (a Pallas "
-                          "kernel cannot ride a GSPMD-auto axis), so "
-                          "dropout adds no further cliff there — but it "
-                          "is not fused either.")
             master_print(
-                f"WARNING: --att_dropout {cfg.att_dropout} > 0: {detail} "
-                f"The whole-N and streaming kernels (sp=1; pp without tp "
-                f"included — the body seeds per-shard keys) run dropout "
-                f"fused.")
+                f"WARNING: --att_dropout {cfg.att_dropout} > 0: "
+                + "; ".join(details) + ". The whole-N and streaming "
+                "kernels (incl. pp without tp and ulysses sp — seeded "
+                "per shard) run dropout fused.")
 
     if sp > 1:
         if n % sp != 0 or cfg.num_heads % tp != 0:
@@ -901,15 +921,32 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
                 # all-to-all head<->token resharding; the inner kernel sees
                 # the full sequence, so the Pallas cores apply on TPU
                 from vitax.parallel.ulysses import (make_ulysses_attention,
-                                                    make_ulysses_attention_pp)
+                                                    make_ulysses_attention_pp,
+                                                    make_ulysses_dropout)
                 inner, _ = _tpu_kernel(cfg, n, force=force_tpu_kernels,
                                        local_heads=cfg.num_heads // (sp * tp))
                 wrapped = _named(make_ulysses_attention(mesh, inner),
                                  "ulysses all-to-all (sp)")
+                drop_inner = _tpu_dropout_kernel(
+                    cfg, n, force=force_tpu_kernels,
+                    local_heads=cfg.num_heads // (sp * tp))
+                if drop_inner is not None:
+                    # sp with fused dropout (round 5): the resharded inner
+                    # kernel runs the in-kernel mask on its full-sequence
+                    # head slice (vitax/parallel/ulysses.py)
+                    wrapped.vitax_dropout = make_ulysses_dropout(
+                        mesh, drop_inner)
                 # pp x sp: manualize only (sp, tp) inside the pipeline body
                 wrapped.vitax_pp_impl = _named(
                     make_ulysses_attention_pp(inner, with_tp=tp > 1),
                     "ulysses all-to-all (sp, pp body)")
+                if drop_inner is not None and tp == 1:
+                    # pp x sp x dropout: the body's local a2a + dropout
+                    # inner; the pipeline's per-(tick, layer, shard) keys
+                    # provide the per-shard decorrelation
+                    from vitax.parallel.ulysses import make_ulysses_dropout_pp
+                    wrapped.vitax_pp_impl.vitax_dropout = (
+                        make_ulysses_dropout_pp(drop_inner))
                 return wrapped
             from vitax.utils.logging import master_print
             master_print(
@@ -958,19 +995,12 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
         check_vma=False,
     ), name + " + shard_map")
     if drop_kernel is not None:
-        # each shard sees only LOCAL (batch, head) block indices, so two
-        # shards would generate identical masks for their local blocks —
-        # fold the linearized shard position into the seed to decorrelate
         shard_axes = tuple(a for a in (*BATCH_AXES, "tp")
                            if mesh.shape.get(a, 1) > 1)
 
         def drop_body(q, k, v, seed):
-            idx = jnp.uint32(0)
-            for ax in shard_axes:
-                idx = (idx * jnp.uint32(mesh.shape[ax])
-                       + jax.lax.axis_index(ax).astype(jnp.uint32))
-            return drop_kernel(q, k, v,
-                               seed ^ _fmix32(idx * jnp.uint32(_GOLD_BH)))
+            return drop_kernel(q, k, v, fold_shard_seed(mesh, shard_axes,
+                                                        seed))
 
         wrapped.vitax_dropout = jax.shard_map(
             drop_body, mesh=mesh,

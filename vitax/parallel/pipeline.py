@@ -149,10 +149,6 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
         assert cfg.num_patches % sp == 0, (
             f"pp x sp needs num_patches {cfg.num_patches} divisible by "
             f"sp {sp}")
-        assert cfg.att_dropout == 0.0, (
-            "pp x sp excludes --att_dropout > 0: the Block's dropout "
-            "fallback computes dense attention, which is wrong on a local "
-            "token shard")
     has_block_dropout = cfg.att_dropout > 0 or cfg.mlp_dropout > 0
 
     # the model's attention impl may be shard_map-wrapped (multi-device
@@ -176,6 +172,14 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
             "pp x sp needs an sp-aware attention impl in the pipeline body "
             "(ring/ulysses via make_attention_impl); got None — check "
             "num_heads divisibility by tp (and sp*tp for ulysses)")
+        # att_dropout under manual sp must ride an sp-aware DROPOUT body
+        # (ulysses carries one, round 5); the dense fallback would softmax
+        # local token shards — wrong, and the ring body has no dropout hook
+        assert cfg.att_dropout == 0.0 or getattr(
+            bk["attention_impl"], "vitax_dropout", None) is not None, (
+            "pp x sp with --att_dropout > 0 needs a body impl with an "
+            "in-kernel dropout variant — --sp_impl ulysses (tp=1) carries "
+            "one; the ring body does not")
     # mesh-level sharding anchors are meaningless on the per-device values
     # inside shard_map (and NamedSharding constraints are illegal there)
     bk["token_sharding"] = None

@@ -72,6 +72,55 @@ def make_ulysses_attention(mesh: Mesh, inner: Optional[Callable] = None,
     return ulysses_attention
 
 
+def make_ulysses_dropout(mesh: Mesh, inner_drop: Callable,
+                         axis_name: str = "sp"):
+    """Ulysses attention with in-kernel attention dropout (round 5): the
+    resharded inner kernel sees the FULL sequence on its head slice, so the
+    whole-N/streaming dropout kernels apply directly. Each shard holds a
+    DIFFERENT (head-slice, batch-shard) of the problem but the same local
+    (b, h) block indices, so the linearized shard position is folded into
+    the seed (fold_shard_seed — the one fold idiom shared with attention.py)
+    — distinct masks per shard, deterministic given (seed, step).
+
+    inner_drop: (q, k, v, seed) -> o on local (B, N, H_local, Dh)."""
+    from vitax.ops.attention import fold_shard_seed
+
+    spec = P(BATCH_AXES, axis_name, "tp", None)
+    shard_axes = tuple(a for a in (*BATCH_AXES, axis_name, "tp")
+                       if mesh.shape.get(a, 1) > 1)
+
+    def body(q, k, v, seed):
+        # the a2a choreography is _ulysses_local's — one copy of the layout
+        # the dropout oracle test pins (tests/test_ulysses.py)
+        seed = fold_shard_seed(mesh, shard_axes, seed)
+        return _ulysses_local(
+            q, k, v, inner=lambda a, b, c: inner_drop(a, b, c, seed),
+            axis_name=axis_name)
+
+    def ulysses_dropout(q, k, v, seed):
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(spec, spec, spec, P()), out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v, seed)
+
+    return ulysses_dropout
+
+
+def make_ulysses_dropout_pp(inner_drop: Callable, axis_name: str = "sp"):
+    """Ulysses dropout for use INSIDE the pipeline body (pp x sp, tp=1):
+    the local a2a body with the in-kernel dropout inner. No seed fold here —
+    the pipeline body's per-(tick, layer, shard) keys already decorrelate
+    across sp shards (vitax/parallel/pipeline.py shard_idx), and each sp
+    shard computes a DISJOINT head slice after the a2a."""
+    def body(q, k, v, seed):
+        return _ulysses_local(
+            q, k, v, inner=lambda a, b, c: inner_drop(a, b, c, seed),
+            axis_name=axis_name)
+    return body
+
+
 def make_ulysses_attention_pp(inner: Optional[Callable] = None,
                               axis_name: str = "sp", with_tp: bool = False):
     """Ulysses attention for use INSIDE the pipeline body (pp x sp).
