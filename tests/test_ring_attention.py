@@ -108,3 +108,57 @@ def test_sequence_parallel_train_step_equivalence(devices8):
     _, losses_base = run_steps(cfg_base, n_steps=4)
     assert all(np.isfinite(losses_sp))
     np.testing.assert_allclose(losses_sp, losses_base, rtol=2e-4)
+
+
+import pytest
+
+
+@pytest.mark.parametrize("use_kernel", [False, True])
+def test_ring_dropout_matches_masked_dense(devices8, use_kernel):
+    """Ring in-kernel dropout (round 5) == dense attention with the global
+    counter-hash mask: each (q-shard, kv-block) product masks its numerator
+    at GLOBAL (q0, k0) token offsets and every (q, k) element is computed by
+    exactly one shard, so the lse merge reconstructs dense softmax-then-drop
+    exactly — for both the dense and the Pallas (interpret) block products,
+    grads included."""
+    from vitax.ops.attention import dropout_keep_mask
+    from vitax.parallel.ring_attention import make_ring_dropout
+
+    cfg = sp_cfg(sp_size=2, fsdp_size=1, att_dropout=0.3)
+    mesh = build_mesh(cfg, devices=jax.devices()[:2])  # pure sp2
+    rate = cfg.att_dropout
+    ring_drop = make_ring_dropout(mesh, rate, use_kernel=use_kernel)
+
+    b, n, h, dh = 3, 16, 2, 8
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(kq, (b, n, h, dh), jnp.float32)
+    k = jax.random.normal(kk, (b, n, h, dh), jnp.float32)
+    v = jax.random.normal(kv, (b, n, h, dh), jnp.float32)
+    seed = jnp.uint32(31)
+
+    def dense_masked(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * dh ** -0.5
+        probs = jax.nn.softmax(s, axis=-1)
+        mask = jnp.stack([jnp.stack([
+            dropout_keep_mask(seed, jnp.uint32(bi * h + hi), n, n, rate)
+            for hi in range(h)]) for bi in range(b)])
+        return jnp.einsum("bhqk,bkhd->bqhd", probs * mask / (1 - rate), v)
+
+    out = jax.jit(lambda q, k, v: ring_drop(q, k, v, seed))(q, k, v)
+    want = dense_masked(q, k, v)
+    assert not np.allclose(np.asarray(out),
+                           np.asarray(reference_attention(q, k, v)),
+                           atol=1e-3)  # the mask actually bit
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    got = jax.grad(loss(lambda q, k, v: ring_drop(q, k, v, seed)),
+                   argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(loss(dense_masked), argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3)

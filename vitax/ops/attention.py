@@ -481,6 +481,8 @@ def flash_attention_4d(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 
 def _fwd_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                      scale: float, rate: float):
+    # seed_ref: (3,) uint32 SMEM — [seed, q0, k0]; the offsets shift the mask
+    # to GLOBAL token coordinates (ring attention's per-shard blocks)
     q = q_ref[0]  # (N, Dh)
     k = k_ref[0]
     v = v_ref[0]
@@ -490,7 +492,8 @@ def _fwd_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     mask = dropout_keep_mask(seed_ref[0], jnp.uint32(pl.program_id(0)),
-                             q.shape[0], k.shape[0], rate)
+                             q.shape[0], k.shape[0], rate,
+                             q0=seed_ref[1], k0=seed_ref[2])
     o = jax.lax.dot_general(
         (p * mask).astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -499,19 +502,22 @@ def _fwd_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 
 def _bwd_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
-                     dq_ref, dk_ref, dv_ref, *, scale: float, rate: float):
+                     dlse_ref, dq_ref, dk_ref, dv_ref, *, scale: float,
+                     rate: float):
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
     o = o_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][0][:, None]    # (N, 1)
+    dlse = dlse_ref[0][0][:, None]  # (N, 1) — nonzero under ring's merge
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
     probs = jnp.exp(s - lse)        # softmax probabilities, (N, N) f32
     ms = dropout_keep_mask(seed_ref[0], jnp.uint32(pl.program_id(0)),
-                           q.shape[0], k.shape[0], rate) / (1.0 - rate)
+                           q.shape[0], k.shape[0], rate,
+                           q0=seed_ref[1], k0=seed_ref[2]) / (1.0 - rate)
     a = probs * ms                  # dropped/scaled probabilities
 
     ab = a.astype(q_ref.dtype)
@@ -521,7 +527,8 @@ def _bwd_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
     dp = jax.lax.dot_general(  # dO V^T
         dob, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
     delta = jnp.sum(do * o, axis=-1, keepdims=True)  # = dot(dprobs, probs)
-    ds = (probs * (dp * ms - delta) * scale).astype(q_ref.dtype)
+    # d lse_i/d s_ij = probs_ij (the UNMASKED softmax — lse ignores dropout)
+    ds = (probs * (dp * ms - delta + dlse) * scale).astype(q_ref.dtype)
 
     dq_ref[0] = jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
@@ -537,7 +544,15 @@ def _seed_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _fwd_bh_drop(q, k, v, seed, scale, rate):
+def _seedvec(seed, q0=0, k0=0):
+    """(3,) uint32 [seed, q0, k0] for the dropout kernels' SMEM input."""
+    z = jnp.uint32
+    return jnp.stack([seed.astype(jnp.uint32),
+                      jnp.asarray(q0, jnp.int32).astype(z),
+                      jnp.asarray(k0, jnp.int32).astype(z)])
+
+
+def _fwd_bh_drop(q, k, v, seedvec, scale, rate):
     bh, n, dh = q.shape
     spec = pl.BlockSpec((1, n, dh), lambda i: (i, 0, 0))
     lse_spec = pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0))
@@ -551,40 +566,51 @@ def _fwd_bh_drop(q, k, v, seed, scale, rate):
             jax.ShapeDtypeStruct((bh, 1, n), jnp.float32),
         ],
         interpret=_interpret(),
-    )(seed.reshape(1), q, k, v)
+    )(seedvec, q, k, v)
     return o, lse[:, 0, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_bh_dropout(q, k, v, seed, scale, rate):
-    """(BH, N, Dh) fused attention with attention dropout; seed is a traced
-    uint32 scalar (fold the step/layer rng in before calling)."""
-    return _fwd_bh_drop(q, k, v, seed, scale, rate)[0]
+def flash_bh_dropout_lse(q, k, v, seedvec, scale, rate):
+    """(BH, N, Dh) fused attention with attention dropout, returning
+    (o, lse); differentiable in both outputs (the lse cotangent feeds the
+    backward — ring attention's merge needs it). seedvec: (3,) uint32
+    [seed, q0, k0] (_seedvec)."""
+    return _fwd_bh_drop(q, k, v, seedvec, scale, rate)
 
 
-def _flash_bh_drop_fwd(q, k, v, seed, scale, rate):
-    o, lse = _fwd_bh_drop(q, k, v, seed, scale, rate)
-    return o, (q, k, v, o, lse, seed)
+def _flash_bh_drop_fwd(q, k, v, seedvec, scale, rate):
+    o, lse = _fwd_bh_drop(q, k, v, seedvec, scale, rate)
+    return (o, lse), (q, k, v, o, lse, seedvec)
 
 
-def _flash_bh_drop_bwd(scale, rate, res, do):
+def _flash_bh_drop_bwd(scale, rate, res, cts):
     import numpy as np
-    q, k, v, o, lse, seed = res
+    q, k, v, o, lse, seedvec = res
+    do, dlse = cts
     bh, n, dh = q.shape
     spec = pl.BlockSpec((1, n, dh), lambda i: (i, 0, 0))
     lse_spec = pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel_drop, scale=scale, rate=rate),
         grid=(bh,),
-        in_specs=[_seed_spec(), spec, spec, spec, spec, lse_spec, spec],
+        in_specs=[_seed_spec(), spec, spec, spec, spec, lse_spec, spec,
+                  lse_spec],
         out_specs=[spec, spec, spec],
         out_shape=[jax.ShapeDtypeStruct((bh, n, dh), q.dtype)] * 3,
         interpret=_interpret(),
-    )(seed.reshape(1), q, k, v, o, lse[:, None, :], do)
-    return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
+    )(seedvec, q, k, v, o, lse[:, None, :], do, dlse[:, None, :])
+    return dq, dk, dv, np.zeros(seedvec.shape, jax.dtypes.float0)
 
 
-flash_bh_dropout.defvjp(_flash_bh_drop_fwd, _flash_bh_drop_bwd)
+flash_bh_dropout_lse.defvjp(_flash_bh_drop_fwd, _flash_bh_drop_bwd)
+
+
+def flash_bh_dropout(q, k, v, seed, scale, rate, q0=0, k0=0):
+    """(BH, N, Dh) fused attention with attention dropout; seed is a traced
+    uint32 scalar (fold the step/layer rng in before calling)."""
+    return flash_bh_dropout_lse(q, k, v, _seedvec(seed, q0, k0),
+                                scale, rate)[0]
 
 
 def _fwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
@@ -607,7 +633,8 @@ def _fwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         bh = (pl.program_id(0) * heads_total
               + pl.program_id(1) * heads + i)
         maskT = dropout_keep_mask(seed_ref[0], jnp.uint32(bh), n, n, rate,
-                                  transposed=True)   # (Nk, Nq)
+                                  transposed=True, q0=seed_ref[1],
+                                  k0=seed_ref[2])    # (Nk, Nq)
         o = jax.lax.dot_general(                     # (Nq, Dh)
             ((p * maskT) / (l * (1.0 - rate))).astype(v.dtype), v,
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -621,8 +648,8 @@ def _fwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 
 def _bwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
-                      dq_ref, dk_ref, dv_ref, *, heads, heads_total, scale,
-                      rate, pad_rows):
+                      dlse_ref, dq_ref, dk_ref, dv_ref, *, heads,
+                      heads_total, scale, rate, pad_rows):
     dh = q_ref.shape[-1] // heads
     n = q_ref.shape[1]
     ones_row = jnp.ones((1, dh), jnp.float32)
@@ -634,7 +661,9 @@ def _bwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         o = o_ref[0][:, sl].astype(jnp.float32)
         do = do_ref[0][:, sl].astype(jnp.float32)
         lse_blk = lse_ref[0, 0] if pad_rows else lse_ref[0]
+        dlse_blk = dlse_ref[0, 0] if pad_rows else dlse_ref[0]
         lse_row = lse_blk[i:i + 1, :]                # (1, Nq) f32
+        dlse_row = dlse_blk[i:i + 1, :]
 
         sT = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
@@ -643,7 +672,8 @@ def _bwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         bh = (pl.program_id(0) * heads_total
               + pl.program_id(1) * heads + i)
         msT = dropout_keep_mask(seed_ref[0], jnp.uint32(bh), n, n, rate,
-                                transposed=True) / (1.0 - rate)
+                                transposed=True, q0=seed_ref[1],
+                                k0=seed_ref[2]) / (1.0 - rate)
         aT = probsT * msT
 
         aTb = aT.astype(q_ref.dtype)
@@ -657,7 +687,8 @@ def _bwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         delta_row = jax.lax.dot_general(
             ones_row, do * o, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # (1, Nq)
-        dsT = (probsT * (dpT * msT - delta_row) * scale).astype(q_ref.dtype)
+        dsT = (probsT * (dpT * msT - delta_row + dlse_row)
+               * scale).astype(q_ref.dtype)
 
         dq_ref[0, :, sl] = jax.lax.dot_general(
             dsT, k, (((0,), (0,)), ((), ())),
@@ -668,7 +699,7 @@ def _bwd4_kernel_drop(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
 
 
-def _fwd4_drop(q, k, v, seed, scale, rate):
+def _fwd4_drop(q, k, v, seedvec, scale, rate):
     b, n, h, dh = q.shape
     hb = _heads_per_program(n, h, dh, q.dtype.itemsize)
     assert hb is not None, (n, h, dh)
@@ -692,53 +723,64 @@ def _fwd4_drop(q, k, v, seed, scale, rate):
             jax.ShapeDtypeStruct(lse_shape, jnp.float32),
         ],
         interpret=_interpret(),
-    )(seed.reshape(1), q3, k3, v3)
+    )(seedvec, q3, k3, v3)
     if pad:
         lse = lse[:, :, :hb, :].reshape(b, h, n)
     return o.reshape(b, n, h, dh), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash4_dropout(q, k, v, seed, scale, rate):
-    """(B, N, H, Dh) fused attention with in-kernel attention dropout."""
-    return _fwd4_drop(q, k, v, seed, scale, rate)[0]
+def flash4_dropout_lse(q, k, v, seedvec, scale, rate):
+    """(B, N, H, Dh) fused attention with in-kernel attention dropout,
+    returning (o, lse (B, H, N)); differentiable in both outputs."""
+    return _fwd4_drop(q, k, v, seedvec, scale, rate)
 
 
-def _flash4_drop_fwd(q, k, v, seed, scale, rate):
-    o, lse = _fwd4_drop(q, k, v, seed, scale, rate)
-    return o, (q, k, v, o, lse, seed)
+def _flash4_drop_fwd(q, k, v, seedvec, scale, rate):
+    o, lse = _fwd4_drop(q, k, v, seedvec, scale, rate)
+    return (o, lse), (q, k, v, o, lse, seedvec)
 
 
-def _flash4_drop_bwd(scale, rate, res, do):
+def _flash4_drop_bwd(scale, rate, res, cts):
     import numpy as np
-    q, k, v, o, lse, seed = res
+    q, k, v, o, lse, seedvec = res
+    do, dlse = cts
     b, n, h, dh = q.shape
     hb = _heads_per_program(n, h, dh, q.dtype.itemsize)
     pad = _lse_pad_rows(hb, h)
     flat = (x.reshape(b, n, h * dh) for x in (q, k, v, o, do))
     q3, k3, v3, o3, do3 = flat
     spec = pl.BlockSpec((1, n, hb * dh), lambda i, j: (i, 0, j))
-    if pad:
-        g = lse.reshape(b, h // hb, hb, n)
-        lse_in = jnp.pad(g, ((0, 0), (0, 0), (0, pad - hb), (0, 0)))
+    if pad:  # re-pad (B, H, N) to the grouped layout the kernel blocks need
+        def regroup(x):
+            g = x.reshape(b, h // hb, hb, n)
+            return jnp.pad(g, ((0, 0), (0, 0), (0, pad - hb), (0, 0)))
+        lse_in, dlse_in = regroup(lse), regroup(dlse)
         lse_spec = pl.BlockSpec((1, 1, pad, n), lambda i, j: (i, j, 0, 0))
     else:
-        lse_in = lse
+        lse_in, dlse_in = lse, dlse
         lse_spec = pl.BlockSpec((1, hb, n), lambda i, j: (i, j, 0))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd4_kernel_drop, heads=hb, heads_total=h,
                           scale=scale, rate=rate, pad_rows=pad),
         grid=(b, h // hb),
-        in_specs=[_seed_spec(), spec, spec, spec, spec, lse_spec, spec],
+        in_specs=[_seed_spec(), spec, spec, spec, spec, lse_spec, spec,
+                  lse_spec],
         out_specs=[spec, spec, spec],
         out_shape=[jax.ShapeDtypeStruct((b, n, h * dh), q.dtype)] * 3,
         interpret=_interpret(),
-    )(seed.reshape(1), q3, k3, v3, o3, lse_in, do3)
+    )(seedvec, q3, k3, v3, o3, lse_in, do3, dlse_in)
     return (*(x.reshape(b, n, h, dh) for x in (dq, dk, dv)),
-            np.zeros(seed.shape, jax.dtypes.float0))
+            np.zeros(seedvec.shape, jax.dtypes.float0))
 
 
-flash4_dropout.defvjp(_flash4_drop_fwd, _flash4_drop_bwd)
+flash4_dropout_lse.defvjp(_flash4_drop_fwd, _flash4_drop_bwd)
+
+
+def flash4_dropout(q, k, v, seed, scale, rate, q0=0, k0=0):
+    """(B, N, H, Dh) fused attention with in-kernel attention dropout."""
+    return flash4_dropout_lse(q, k, v, _seedvec(seed, q0, k0),
+                              scale, rate)[0]
 
 
 def _tpu_dropout_kernel(cfg, n: int, force: bool = False,
@@ -813,6 +855,34 @@ def block_kernel_with_lse(n: int, h: int, dh: int, itemsize: int):
     return bh
 
 
+def block_dropout_kernel_with_lse(n: int, h: int, dh: int, itemsize: int):
+    """Dropout analog of block_kernel_with_lse, for ring attention's local
+    block products: kern(q, k, v, seedvec, scale, rate) -> (o, lse (B,h,n)),
+    differentiable in both outputs. seedvec carries [seed, q0, k0] so the
+    mask is evaluated at GLOBAL token coordinates — every ring step's block
+    reproduces exactly the decisions the whole-(N, N) mask makes there,
+    which is what makes ring dropout equal dense masked attention."""
+    path = _select_path(n, h, dh, itemsize)
+    if path == "4d":
+        return flash4_dropout_lse
+    if path == "streaming":
+        from vitax.ops.flash_blocked import (
+            DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, blocked_bh_dropout_lse)
+
+        def streaming(q, k, v, seedvec, scale, rate):
+            o, lse = blocked_bh_dropout_lse(
+                _to_bh(q), _to_bh(k), _to_bh(v), seedvec, scale, rate,
+                DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+            return _from_bh(o, q.shape), lse.reshape(q.shape[0], h, n)
+        return streaming
+
+    def bh(q, k, v, seedvec, scale, rate):
+        o, lse = flash_bh_dropout_lse(_to_bh(q), _to_bh(k), _to_bh(v),
+                                      seedvec, scale, rate)
+        return _from_bh(o, q.shape), lse.reshape(q.shape[0], h, n)
+    return bh
+
+
 def _to_bh(x):  # (B, N, H, Dh) -> (B*H, N, Dh)
     b, n, h, dh = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
@@ -876,12 +946,14 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
     force_tpu_kernels=True makes the same selections off-TPU with the Pallas
     kernels in interpret mode (the multichip dryrun's production-path sweep).
 
-    Attention dropout: the whole-N AND streaming kernels carry an in-kernel
-    dropout variant (exposed as impl.vitax_dropout, taking (q, k, v, seed));
-    the Block uses it for training steps, so --att_dropout > 0 keeps the
-    fused path, including inside the pipeline body (the raw kernel rides
-    vitax_local_impl there). Only the sp paths and pp-under-tp still fall
-    back to dense under dropout — warned below when that applies.
+    Attention dropout: every path that can run kernels runs dropout
+    IN-KERNEL (exposed as impl.vitax_dropout, taking (q, k, v, seed)) — the
+    whole-N and streaming kernels, the pipeline body (raw kernel on
+    vitax_local_impl), ulysses sp (resharded inner kernel), and ring sp
+    (global-coordinate masks per (q-shard, kv-block), which make the merged
+    result equal dense masked attention). The sole dense-under-dropout
+    surface is pp-under-tp (structural — warned below); pp x sp ring +
+    dropout is a hard error in pipeline.py (use ulysses there).
     """
     n = cfg.num_patches
 
@@ -890,28 +962,20 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
 
     if cfg.use_flash_attention and cfg.att_dropout > 0.0:
         pp = getattr(cfg, "pp_size", 1)
-        ulysses_drop_ok = (getattr(cfg, "sp_impl", "ring") == "ulysses"
-                           and cfg.num_heads % max(sp * tp, 1) == 0)
-        details = []  # each applicable cause gets its own sentence
-        if sp > 1 and not ulysses_drop_ok:
-            details.append(
-                "ring sequence parallelism has no in-kernel dropout "
-                "variant (--sp_impl ulysses carries one) — training falls "
-                "back to the dense O(N^2) attention path; eval still uses "
-                "the kernel")
         if pp > 1 and tp > 1:
-            details.append(
-                "the pipeline body under tp runs the dense einsum path "
-                "for BOTH train and eval (a Pallas kernel cannot ride a "
-                "GSPMD-auto axis), so dropout adds no further cliff there "
-                "— but it is not fused either")
-        if details:
+            # the one remaining non-fused dropout surface: the pipeline
+            # body under tp runs the dense einsum path for BOTH train and
+            # eval (a Pallas kernel cannot ride a GSPMD-auto axis), so
+            # dropout adds no further cliff there — but it is not fused.
+            # (ring/ulysses sp and pp-without-tp all run dropout in-kernel;
+            # pp x sp ring + dropout is a hard error in pipeline.py.)
             from vitax.utils.logging import master_print
             master_print(
-                f"WARNING: --att_dropout {cfg.att_dropout} > 0: "
-                + "; ".join(details) + ". The whole-N and streaming "
-                "kernels (incl. pp without tp and ulysses sp — seeded "
-                "per shard) run dropout fused.")
+                f"WARNING: --att_dropout {cfg.att_dropout} > 0 with the "
+                f"pipeline body under tp runs unfused dense attention "
+                f"(train AND eval — inherent to tp-in-pp, not to dropout). "
+                f"Every kernel path (whole-N, streaming, ring/ulysses sp, "
+                f"pp without tp) runs dropout fused.")
 
     if sp > 1:
         if n % sp != 0 or cfg.num_heads % tp != 0:
@@ -954,7 +1018,8 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
                 f"sp*tp ({cfg.num_heads} % {sp * tp} != 0); falling back to "
                 f"ring attention")
         from vitax.parallel.ring_attention import (make_ring_attention,
-                                                   make_ring_attention_pp)
+                                                   make_ring_attention_pp,
+                                                   make_ring_dropout)
         # local block product through the Pallas kernels on TPU (whole-N or
         # streaming by local length), dense jnp when kernels are disabled
         if not cfg.use_flash_attention:
@@ -963,6 +1028,12 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
             use_kernel = True if force_tpu_kernels else None  # None = on-TPU
         wrapped = _named(make_ring_attention(mesh, use_kernel=use_kernel),
                          "ring attention (sp)")
+        if cfg.att_dropout > 0.0:
+            # ring dropout (round 5): global-coordinate masks per
+            # (q-shard, kv-block) make the merged result equal dense masked
+            # attention — works on both the kernel and dense block products
+            wrapped.vitax_dropout = make_ring_dropout(
+                mesh, float(cfg.att_dropout), use_kernel=use_kernel)
         # pp x sp: manualize only (sp, tp) inside the pipeline body
         wrapped.vitax_pp_impl = _named(
             make_ring_attention_pp(use_kernel=use_kernel, with_tp=tp > 1),

@@ -85,9 +85,12 @@ def _fwd_kernel(n_valid_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # semantics, same as the whole-N dropout kernels (vitax/ops/attention.py)
     l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
     if rate > 0.0:
+        # seed_ref: (3,) uint32 [seed, q0_base, k0_base] — the bases shift
+        # the whole mask to GLOBAL token coordinates (ring attention)
         p = p * dropout_keep_mask(
             seed_ref[0], jnp.uint32(pl.program_id(0)), bq, bk, rate,
-            q0=pl.program_id(1) * bq, k0=j * bk)
+            q0=seed_ref[1] + jnp.uint32(pl.program_id(1) * bq),
+            k0=seed_ref[2] + jnp.uint32(j * bk))
     acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -108,7 +111,7 @@ def blocked_fwd_padded(q, k, v, n_valid, scale, bq, bk, seed=None,
     bh, n_pad, dh = q.shape
     nq, nk = n_pad // bq, n_pad // bk
     if seed is None:
-        seed = jnp.zeros((1,), jnp.uint32)
+        seed = jnp.zeros((3,), jnp.uint32)
     qspec = pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0))
     lse_spec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
@@ -172,7 +175,8 @@ def _dkv_kernel(n_valid_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         # equals the softmax-vjp inner product under the mask)
         ms = dropout_keep_mask(
             seed_ref[0], jnp.uint32(pl.program_id(0)), bq, bk, rate,
-            q0=jq * bq, k0=jk * bk) / (1.0 - rate)
+            q0=seed_ref[1] + jnp.uint32(jq * bq),
+            k0=seed_ref[2] + jnp.uint32(jk * bk)) / (1.0 - rate)
         a = p * ms
     else:
         a = p
@@ -221,7 +225,8 @@ def _dq_kernel(n_valid_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     if rate > 0.0:
         dp = dp * (dropout_keep_mask(
             seed_ref[0], jnp.uint32(pl.program_id(0)), bq, bk, rate,
-            q0=pl.program_id(1) * bq, k0=jk * bk) / (1.0 - rate))
+            q0=seed_ref[1] + jnp.uint32(pl.program_id(1) * bq),
+            k0=seed_ref[2] + jnp.uint32(jk * bk)) / (1.0 - rate))
     ds = p * (dp - delta + dlse) * scale
     dq_acc[...] += jax.lax.dot_general(
         ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -237,7 +242,7 @@ def blocked_bwd_padded(q, k, v, o, lse, do, dlse, n_valid, scale, bq, bk,
     bh, n_pad, dh = q.shape
     nq, nk = n_pad // bq, n_pad // bk
     if seed is None:
-        seed = jnp.zeros((1,), jnp.uint32)
+        seed = jnp.zeros((3,), jnp.uint32)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]  # (BH, 1, Np)
     lse3 = lse[:, None, :]
@@ -377,29 +382,38 @@ def blocked_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def blocked_bh_dropout_lse(q, k, v, seedvec, scale, rate, bq, bk):
+    """(BH, N, Dh) streaming attention with attention dropout, returning
+    (o, lse); differentiable in both outputs (ring attention's merge).
+    seedvec: (3,) uint32 [seed, q0, k0] (vitax.ops.attention._seedvec)."""
+    return _blocked_fwd_impl(q, k, v, scale, bq, bk, seed=seedvec,
+                             rate=rate)
+
+
+def _blocked_drop_fwd(q, k, v, seedvec, scale, rate, bq, bk):
+    o, lse = _blocked_fwd_impl(q, k, v, scale, bq, bk, seed=seedvec,
+                               rate=rate)
+    return (o, lse), (q, k, v, o, lse, seedvec)
+
+
+def _blocked_drop_bwd(scale, rate, bq, bk, res, cts):
+    import numpy as np
+    q, k, v, o, lse, seedvec = res
+    do, dlse = cts
+    dq, dk, dv = _blocked_bwd_impl(
+        q, k, v, o, lse, do, dlse, scale, bq, bk, seed=seedvec, rate=rate)
+    return dq, dk, dv, np.zeros(seedvec.shape, jax.dtypes.float0)
+
+
+blocked_bh_dropout_lse.defvjp(_blocked_drop_fwd, _blocked_drop_bwd)
+
+
 def blocked_bh_dropout(q, k, v, seed, scale, rate, bq, bk):
     """(BH, N, Dh) streaming attention with attention dropout; seed is a
     traced uint32 scalar."""
-    return _blocked_fwd_impl(q, k, v, scale, bq, bk,
-                             seed=seed.reshape(1), rate=rate)[0]
-
-
-def _blocked_drop_fwd(q, k, v, seed, scale, rate, bq, bk):
-    o, lse = _blocked_fwd_impl(q, k, v, scale, bq, bk,
-                               seed=seed.reshape(1), rate=rate)
-    return o, (q, k, v, o, lse, seed)
-
-
-def _blocked_drop_bwd(scale, rate, bq, bk, res, do):
-    import numpy as np
-    q, k, v, o, lse, seed = res
-    dq, dk, dv = _blocked_bwd_impl(
-        q, k, v, o, lse, do, jnp.zeros_like(lse), scale, bq, bk,
-        seed=seed.reshape(1), rate=rate)
-    return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
-
-
-blocked_bh_dropout.defvjp(_blocked_drop_fwd, _blocked_drop_bwd)
+    from vitax.ops.attention import _seedvec
+    return blocked_bh_dropout_lse(q, k, v, _seedvec(seed), scale, rate,
+                                  bq, bk)[0]
 
 
 def blocked_dropout_attention(q, k, v, seed, rate: float,

@@ -79,9 +79,15 @@ def _merge(o, lse, o_blk, lse_blk):
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
-                          block_fn: Callable):
+                          block_fn: Callable, step_args: Callable = None):
     """shard_map body. q, k, v: (B, N_loc, H, Dh) — the local token shard.
-    Streams K/V blocks around the ring; each device visits all sp blocks."""
+    Streams K/V blocks around the ring; each device visits all sp blocks.
+
+    step_args(step) -> tuple of extra positional args appended to each
+    block_fn call (the dropout path's per-step seedvec); None for the plain
+    (q, k, v, scale) products. ONE copy of the ring machinery — the
+    prefetch-before-compute ordering below is load-bearing for the
+    latency hiding described in the module docstring."""
     sp = jax.lax.axis_size(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -97,7 +103,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
             # issue the rotation BEFORE the block product — no data dependence,
             # so the collective-permute overlaps the MXU work (double buffer)
             kv_nxt = jax.lax.ppermute(kv_blk, axis_name, perm)
-        o_blk, lse_blk = block_fn(q, kv_blk[0], kv_blk[1], scale)
+        extra = () if step_args is None else step_args(step)
+        o_blk, lse_blk = block_fn(q, kv_blk[0], kv_blk[1], scale, *extra)
+        o_blk = o_blk.astype(jnp.float32)
         o, lse = (o_blk, lse_blk) if o is None else _merge(o, lse, o_blk, lse_blk)
         if not last:
             kv_blk = kv_nxt
@@ -128,6 +136,105 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
         return fn(q, k, v)
 
     return ring_attention
+
+
+def _dense_block_drop(q, k, v, seedvec, scale: float, rate: float):
+    """Dense jnp block product with the shared counter-hash dropout mask at
+    GLOBAL coordinates (seedvec = [seed, q0, k0]); numerator masked, l/lse
+    unmasked, (1-rate) folded per block — linear, so the merge of per-block
+    results equals dense softmax-then-drop exactly."""
+    from vitax.ops.attention import dropout_keep_mask
+
+    b, nq, h, dh = q.shape
+    nk = k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    bh = jnp.arange(b * h, dtype=jnp.uint32)
+    mask = jax.vmap(lambda i: dropout_keep_mask(
+        seedvec[0], i, nq, nk, rate,
+        q0=seedvec[1], k0=seedvec[2]))(bh).reshape(b, h, nq, nk)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p * mask / (l * (1.0 - rate)),
+                   v.astype(jnp.float32))
+    lse = (m + jnp.log(l))[..., 0]  # (B, H, nq)
+    return o, lse
+
+
+def _kernel_block_drop(q, k, v, seedvec, scale: float, rate: float):
+    """Pallas dropout block product (block_dropout_kernel_with_lse — same
+    selection cascade as _kernel_block)."""
+    from vitax.ops.attention import block_dropout_kernel_with_lse
+
+    b, nq, h, dh = q.shape
+    kern = block_dropout_kernel_with_lse(nq, h, dh, q.dtype.itemsize)
+    o, lse = kern(q, k, v, seedvec, scale, rate)
+    return o.astype(jnp.float32), lse
+
+
+def _ring_attention_local_drop(q, k, v, seed, *, axis_name: str,
+                               scale: float, rate: float,
+                               block_fn: Callable):
+    """Ring body with in-kernel dropout: each (q-shard, kv-block) product
+    masks its numerator at the pair's GLOBAL (q0, k0) token offsets, so the
+    merged result equals dense masked attention for the same seed — every
+    (q, k) element is computed by exactly one shard at its global
+    coordinates (tests pin this against the dense oracle). The ring loop
+    itself is _ring_attention_local's (one copy of the machinery); only the
+    per-step seedvec differs."""
+    from vitax.ops.attention import _seedvec
+
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    n_loc = q.shape[1]
+    q0 = idx.astype(jnp.int32) * n_loc
+
+    def step_args(step):
+        # after `step` rotations this shard holds the block that ORIGINATED
+        # on shard (idx - step): its global token offset keys the mask
+        src = (idx - step) % sp
+        return (_seedvec(seed, q0, src.astype(jnp.int32) * n_loc), rate)
+
+    def block_with_drop(q, kk, vv, scale, sv, rate):
+        return block_fn(q, kk, vv, sv, scale, rate)
+
+    return _ring_attention_local(q, k, v, axis_name=axis_name, scale=scale,
+                                 block_fn=block_with_drop,
+                                 step_args=step_args)
+
+
+def make_ring_dropout(mesh: Mesh, rate: float, axis_name: str = "sp",
+                      use_kernel: Optional[bool] = None):
+    """Ring attention with in-kernel attention dropout (round 5): (q, k, v,
+    seed) -> o with the token axis sharded over `axis_name`. The seed is
+    folded over the batch/tp shard position but NOT over sp — sp shards
+    must agree on the global mask for the ring-equals-dense property."""
+    if use_kernel is None:
+        use_kernel = jax.devices()[0].platform == "tpu"
+    block_fn = _kernel_block_drop if use_kernel else _dense_block_drop
+    spec = P(BATCH_AXES, axis_name, "tp", None)
+
+    def ring_dropout(q, k, v, seed):
+        from vitax.ops.attention import fold_shard_seed
+
+        scale = q.shape[-1] ** -0.5
+        shard_axes = tuple(a for a in (*BATCH_AXES, "tp")
+                           if mesh.shape.get(a, 1) > 1)
+
+        def body(q, k, v, seed):
+            seed = fold_shard_seed(mesh, shard_axes, seed)
+            return _ring_attention_local_drop(
+                q, k, v, seed, axis_name=axis_name, scale=scale, rate=rate,
+                block_fn=block_fn)
+
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec, P()),
+            out_specs=spec, check_vma=False,
+        )
+        return fn(q, k, v, seed)
+
+    return ring_dropout
 
 
 def make_ring_attention_pp(axis_name: str = "sp",
