@@ -80,6 +80,30 @@ def test_cross_topology_restore(devices8, tmp_path):
         "tp" in tuple(qkv_c.sharding.spec))
 
 
+def test_cross_topology_restore_moe_pp_ep(devices8, tmp_path):
+    """MoE expert params (stacked (L, E, ...) leaves) save under the
+    ep-sharded scan mesh and restore onto the pp x ep mesh (round-5: the
+    manual-a2a pipeline body). The GLOBAL tree is identical across the two
+    — MoeMlp declares local (E/ep, ...) shapes only INSIDE the pipeline
+    shard_map, never in the checkpoint — so Orbax reshard-on-load covers
+    the composition with no consolidation step."""
+    moe_kw = dict(moe_experts=4, ckpt_dir=str(tmp_path))
+    cfg_a = tiny_cfg(ep_size=2, dp_size=2, fsdp_size=2, **moe_kw)
+    mesh_a, state_a, _ = make_state(cfg_a)
+    save_state(cfg_a.ckpt_dir, 2, state_a, wait=True)
+
+    cfg_b = tiny_cfg(pp_size=2, ep_size=2, dp_size=2, fsdp_size=1, **moe_kw)
+    mesh_b, state_b, sspecs_b = make_state(cfg_b)
+    restored = restore_state(cfg_b.ckpt_dir, 2,
+                             abstract_of(state_b, mesh_b, sspecs_b))
+    for a, b in zip(jax.tree.leaves(state_a), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    w1 = restored.params["params"]["blocks"]["moe"]["w1"]
+    assert w1.sharding.mesh.shape["pp"] == 2
+    spec = tuple(w1.sharding.spec)
+    assert "pp" in spec and "ep" in spec, spec
+
+
 def test_resume_through_loop(devices8, tmp_path):
     """Train 2 epochs saving each; resume from epoch 1 and confirm the step
     counter and params continue from the checkpoint (reference --resume_epoch,

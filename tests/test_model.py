@@ -232,6 +232,13 @@ def test_windowed_remat_v2_moe_and_dropout(devices8, variant):
         _, losses_ref = run_steps(Config(**kw).validate(), n_steps=3)
         assert all(np.isfinite(losses_w))
         np.testing.assert_allclose(losses_w, losses_ref, rtol=2e-4)
+        # and on the expert-sharded mesh: the windowed functional scan's
+        # block.apply carries the same dispatch/token anchors, so ep
+        # sharding must not change the trajectory either
+        kw_ep = {**kw, "fsdp_size": 2, "dp_size": 2}
+        _, losses_ep = run_steps(
+            Config(remat_window=2, ep_size=2, **kw_ep).validate(), n_steps=3)
+        np.testing.assert_allclose(losses_ep, losses_ref, rtol=2e-4)
     else:
         drop = dict(att_dropout=0.2, mlp_dropout=0.1, pos_dropout=0.1)
         cfg_w = Config(remat_window=2, **kw, **drop).validate()
