@@ -11,10 +11,14 @@ That closes the round-4 daylight between "lowers on a virtual CPU mesh" and
 memory_analysis() below is the compiler's own accounting for the pod shape.
 
 Usage:
-    python tools/aot_topology.py [--configs 10b 60b] [--out AOT_TOPOLOGY.json]
+    JAX_PLATFORMS=cpu python tools/aot_topology.py [--configs 10b 60b]
 
 Writes one JSON object per config with the compiled per-device argument /
-temp / output bytes and the HBM bound checked.
+temp / output bytes and the HBM bound checked. Run with the CPU host
+backend: the topology compile client is independent of the default backend,
+and with the axon tunnel down the default (axon) init hangs on the first
+concrete array. libtpu allows ONE process at a time (/tmp/libtpu_lockfile)
+— don't run two topology compiles concurrently.
 """
 
 from __future__ import annotations
@@ -127,10 +131,20 @@ CONFIGS = {
         image_size=224, patch_size=14, embed_dim=5120, num_heads=32,
         num_blocks=32, batch_size=64, pp_size=2, fsdp_size=4, dp_size=1,
         remat_policy="none_saveable")),
+    # MoE under pp x ep at ViT-L width (round-5 composition): the manual
+    # tiled all-to-alls inside the pipeline body must compile for a REAL
+    # TPU target, not just the CPU interpret mesh (~1.3B params: dense L/14
+    # + 8 experts per block)
+    "moe_pp_ep": ("v5p:2x2x2", dict(
+        image_size=224, patch_size=14, embed_dim=1024, num_heads=16,
+        num_blocks=24, batch_size=64, moe_experts=8, pp_size=2, ep_size=2,
+        dp_size=2, fsdp_size=1, remat_policy="none_saveable")),
 }
 
 
 def main():
+    from vitax.platform import force_cpu_if_requested
+    force_cpu_if_requested()
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="+", default=["10b", "60b"],
                     choices=list(CONFIGS))
