@@ -41,7 +41,15 @@ MAX_SEQ_IN_VMEM = 2048  # (N, N) f32 scores: 16 MB at 2048 — VMEM ceiling
 
 
 def _interpret() -> bool:
-    # run the kernels in Pallas interpret mode off-TPU (tests on CPU)
+    # run the kernels in Pallas interpret mode off-TPU (tests on CPU).
+    # VITAX_FORCE_MOSAIC=1 overrides: emit REAL Mosaic kernels regardless of
+    # the host backend — for AOT compiles against TPU topology targets
+    # (tools/aot_topology.py), where the host is CPU but the compile target
+    # is a TPU and interpret-mode lowering would silently swap the
+    # production kernels out of the program being proven.
+    import os
+    if os.environ.get("VITAX_FORCE_MOSAIC"):
+        return False
     return jax.devices()[0].platform != "tpu"
 
 
