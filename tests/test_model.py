@@ -209,7 +209,7 @@ def test_windowed_remat_matches_scan_path(devices8):
     np.testing.assert_allclose(losses_w, losses_ref, rtol=2e-4)
 
 
-@pytest.mark.parametrize("variant", ["moe", "dropout"])
+@pytest.mark.parametrize("variant", ["moe", "dropout", "sp"])
 def test_windowed_remat_v2_moe_and_dropout(devices8, variant):
     """--remat_window v2 (VERDICT r4 weak #3): the 10B family's measured
     winner must compose with the flagship's own flags. MoE is deterministic
@@ -239,6 +239,16 @@ def test_windowed_remat_v2_moe_and_dropout(devices8, variant):
         _, losses_ep = run_steps(
             Config(remat_window=2, ep_size=2, **kw_ep).validate(), n_steps=3)
         np.testing.assert_allclose(losses_ep, losses_ref, rtol=2e-4)
+    elif variant == "sp":
+        # ring sequence parallelism: the windowed functional scan applies
+        # the same shard_map'd attention impl the nn.scan path uses — the
+        # sp trajectory must match the scan path's exactly
+        kw_sp = {**kw, "fsdp_size": 2, "dp_size": 2, "sp_size": 2}
+        _, losses_w = run_steps(Config(remat_window=2, **kw_sp).validate(),
+                                n_steps=3)
+        _, losses_ref = run_steps(Config(**kw_sp).validate(), n_steps=3)
+        assert all(np.isfinite(losses_w))
+        np.testing.assert_allclose(losses_w, losses_ref, rtol=2e-4)
     else:
         drop = dict(att_dropout=0.2, mlp_dropout=0.1, pos_dropout=0.1)
         cfg_w = Config(remat_window=2, **kw, **drop).validate()
