@@ -959,9 +959,9 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
     whole-N and streaming kernels, the pipeline body (raw kernel on
     vitax_local_impl), ulysses sp (resharded inner kernel), and ring sp
     (global-coordinate masks per (q-shard, kv-block), which make the merged
-    result equal dense masked attention). The sole dense-under-dropout
-    surface is pp-under-tp (structural — warned below); pp x sp ring +
-    dropout is a hard error in pipeline.py (use ulysses there).
+    result equal dense masked attention) — each standalone AND inside the
+    pipeline body. The sole dense-under-dropout surface is pp-under-tp
+    (structural — warned below).
     """
     n = cfg.num_patches
 
@@ -975,8 +975,8 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
             # body under tp runs the dense einsum path for BOTH train and
             # eval (a Pallas kernel cannot ride a GSPMD-auto axis), so
             # dropout adds no further cliff there — but it is not fused.
-            # (ring/ulysses sp and pp-without-tp all run dropout in-kernel;
-            # pp x sp ring + dropout is a hard error in pipeline.py.)
+            # (ring/ulysses sp — incl. under pp — and pp-without-tp all run
+            # dropout in-kernel.)
             from vitax.utils.logging import master_print
             master_print(
                 f"WARNING: --att_dropout {cfg.att_dropout} > 0 with the "
@@ -1046,6 +1046,12 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
         wrapped.vitax_pp_impl = _named(
             make_ring_attention_pp(use_kernel=use_kernel, with_tp=tp > 1),
             "ring attention (sp, pp body)")
+        if cfg.att_dropout > 0.0 and tp == 1:
+            # pp x sp x dropout via the local ring body (seeded by the
+            # pipeline's per-(tick, layer, shard) keys)
+            from vitax.parallel.ring_attention import make_ring_dropout_pp
+            wrapped.vitax_pp_impl.vitax_dropout = make_ring_dropout_pp(
+                float(cfg.att_dropout), use_kernel=use_kernel)
         return wrapped
 
     if mesh is not None and mesh.size > 1 and cfg.num_heads % tp != 0:

@@ -173,13 +173,13 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
             "(ring/ulysses via make_attention_impl); got None — check "
             "num_heads divisibility by tp (and sp*tp for ulysses)")
         # att_dropout under manual sp must ride an sp-aware DROPOUT body
-        # (ulysses carries one, round 5); the dense fallback would softmax
-        # local token shards — wrong, and the ring body has no dropout hook
+        # (both ring and ulysses carry one at tp=1, round 5); the dense
+        # fallback would softmax local token shards — silently wrong
         assert cfg.att_dropout == 0.0 or getattr(
             bk["attention_impl"], "vitax_dropout", None) is not None, (
             "pp x sp with --att_dropout > 0 needs a body impl with an "
-            "in-kernel dropout variant — --sp_impl ulysses (tp=1) carries "
-            "one; the ring body does not")
+            "in-kernel dropout variant (ring/ulysses carry one at tp=1; "
+            "under tp the body impl has none)")
     # mesh-level sharding anchors are meaningless on the per-device values
     # inside shard_map (and NamedSharding constraints are illegal there)
     bk["token_sharding"] = None

@@ -237,6 +237,28 @@ def make_ring_dropout(mesh: Mesh, rate: float, axis_name: str = "sp",
     return ring_dropout
 
 
+def make_ring_dropout_pp(rate: float, axis_name: str = "sp",
+                         use_kernel: Optional[bool] = None):
+    """Ring dropout for use INSIDE the pipeline body (pp x sp, tp=1): the
+    local ring body with the dropout block products. The seed comes from
+    the pipeline's per-(tick, layer, shard) keys, which DIFFER across sp
+    shards — valid here: each (q, k) element is computed exactly once, by
+    its q-owner shard, with that shard's seed deciding the mask identically
+    in forward and backward (no cross-shard mask agreement is needed; the
+    global-offset coordinates still decorrelate the kv blocks)."""
+    if use_kernel is None:
+        use_kernel = jax.devices()[0].platform == "tpu"
+    block_fn = _kernel_block_drop if use_kernel else _dense_block_drop
+
+    def ring_dropout_local(q, k, v, seed):
+        scale = q.shape[-1] ** -0.5
+        return _ring_attention_local_drop(
+            q, k, v, seed, axis_name=axis_name, scale=scale, rate=rate,
+            block_fn=block_fn)
+
+    return ring_dropout_local
+
+
 def make_ring_attention_pp(axis_name: str = "sp",
                            use_kernel: Optional[bool] = None,
                            with_tp: bool = False):
