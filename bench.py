@@ -795,6 +795,8 @@ def bench_train(args, metric_stub: str) -> None:
         kw["moe_impl"] = args.moe_impl
     if args.att_dropout is not None:
         kw["att_dropout"] = args.att_dropout
+    if args.grad_accum_steps > 1:
+        kw["grad_accum_steps"] = args.grad_accum_steps
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
@@ -802,7 +804,8 @@ def bench_train(args, metric_stub: str) -> None:
         other_explicit=(not args.grad_ckpt or not args.use_flash_attention
                         or bool(args.batch_size)
                         or args.moe_impl is not None
-                        or args.att_dropout is not None))
+                        or args.att_dropout is not None
+                        or args.grad_accum_steps > 1))
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll, remat_window=args.remat_window,
@@ -849,7 +852,7 @@ def bench_train(args, metric_stub: str) -> None:
     base_entry = read_baseline().get(args.preset, {})
     knobs = ("batch_size", "remat_policy", "scan_blocks", "scan_unroll",
              "remat_window", "grad_ckpt", "use_flash_attention",
-             "moe_impl", "att_dropout")
+             "moe_impl", "att_dropout", "grad_accum_steps")
     # compare only like-for-like: a knob change (e.g. the scan->unrolled
     # default flip) must not masquerade as a same-config speedup. Entries
     # written before a knob existed compare at the Config FIELD DEFAULT —
@@ -882,6 +885,7 @@ def bench_train(args, metric_stub: str) -> None:
             "use_flash_attention": cfg.use_flash_attention,
             "moe_impl": cfg.moe_impl,
             "att_dropout": cfg.att_dropout,
+            "grad_accum_steps": cfg.grad_accum_steps,
         })
 
     emit({
@@ -900,7 +904,8 @@ def bench_train(args, metric_stub: str) -> None:
                   "remat_policy": cfg.remat_policy,
                   "scan_blocks": cfg.scan_blocks,
                   "scan_unroll": cfg.scan_unroll,
-                  "remat_window": cfg.remat_window},
+                  "remat_window": cfg.remat_window,
+                  "grad_accum_steps": cfg.grad_accum_steps},
     })
 
 
@@ -940,6 +945,10 @@ def main():
                    help="MoE dispatch/combine A/B (vitax/models/moe.py): "
                         "einsum (GShard one-hot, default — measured fastest "
                         "on v5e) vs gather (slot-index scatter+gathers)")
+    p.add_argument("--grad_accum_steps", type=int, default=1,
+                   help="K > 1: accumulate grads over K microbatches inside "
+                        "the jitted step (images/sec vs K trade on the train "
+                        "presets; an explicit A/B knob like --batch_size)")
     p.add_argument("--att_dropout", type=float, default=None,
                    help="attention-dropout A/B arm (in-kernel dropout path)")
     p.add_argument("--no_flash_attention", action="store_false",

@@ -1,0 +1,41 @@
+"""Tier-1 lint guard: flake8 over vitax/ tests/ tools/ bench.py with the
+repo's .flake8 settings (max-line-length 120). Skips cleanly when flake8 is
+not installed (the bench/CI images don't ship it); tools/lint.sh is the
+equivalent shell entry point.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_flake8_clean():
+    pytest.importorskip("flake8")
+    r = subprocess.run(
+        [sys.executable, "-m", "flake8", "vitax/", "tests/", "tools/",
+         "bench.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"flake8 findings:\n{r.stdout}\n{r.stderr}"
+
+
+def test_max_line_length_120():
+    """flake8's E501 at 120, enforced without flake8 present: the one lint
+    rule cheap enough to check directly, so the guard still bites on images
+    where test_flake8_clean skips."""
+    bad = []
+    targets = [os.path.join(REPO, "bench.py")]
+    for sub in ("vitax", "tests", "tools"):
+        for dirpath, _, files in os.walk(os.path.join(REPO, sub)):
+            targets += [os.path.join(dirpath, f) for f in files
+                        if f.endswith(".py")]
+    for path in targets:
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if len(line.rstrip("\n")) > 120:
+                    bad.append(f"{os.path.relpath(path, REPO)}:{i} "
+                               f"({len(line.rstrip())} chars)")
+    assert not bad, "lines over 120 chars:\n" + "\n".join(bad)

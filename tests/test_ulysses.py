@@ -134,3 +134,30 @@ def test_ulysses_dropout_matches_masked_dense(devices8):
     # determinism given the seed
     out2 = jax.jit(lambda q, k, v: drop(q, k, v, seed))(q, k, v)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_ulysses_dropout_dense_inner_off_tpu(devices8):
+    """Off-TPU without forced kernels the ulysses flavor now carries a DENSE
+    dropout inner (PR 1 satellite, ADVICE r5) — the two sp flavors behave
+    consistently anywhere ring's _dense_block_drop runs, including the
+    pipeline body at tp=1. The dense inner makes the same counter-hash mask
+    decisions at the same local coordinates as the kernel inner, so its
+    output must match the forced-kernel path."""
+    cfg = sp_cfg(sp_size=2, fsdp_size=1, att_dropout=0.25)
+    mesh = build_mesh(cfg, devices=jax.devices()[:2])
+    impl = make_attention_impl(cfg, mesh)  # no force: dense dropout inner
+    drop = getattr(impl, "vitax_dropout", None)
+    assert drop is not None
+    assert getattr(impl.vitax_pp_impl, "vitax_dropout", None) is not None
+
+    b, n, h, dh = 2, cfg.num_patches, cfg.num_heads, 8
+    q, k, v = (jax.random.normal(kk, (b, n, h, dh), jnp.float32)
+               for kk in jax.random.split(jax.random.key(5), 3))
+    seed = jnp.uint32(29)
+    out = jax.jit(lambda q, k, v: drop(q, k, v, seed))(q, k, v)
+
+    impl_k = make_attention_impl(cfg, mesh, force_tpu_kernels=True)
+    want = jax.jit(
+        lambda q, k, v: impl_k.vitax_dropout(q, k, v, seed))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

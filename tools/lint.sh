@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Lint gate: flake8 (settings in .flake8, max-line-length 120) over the
+# production tree. tests/test_lint.py runs this as a tier-1 guard when
+# flake8 is installed; CI images without flake8 get a clean skip here too.
+set -u
+cd "$(dirname "$0")/.."
+
+if ! python -m flake8 --version >/dev/null 2>&1; then
+    echo "lint: flake8 not installed; skipping (pip install flake8 to enable)"
+    exit 0
+fi
+
+exec python -m flake8 vitax/ tests/ tools/ bench.py

@@ -32,6 +32,7 @@ def main():
                    default=None)
     p.add_argument("--scan_unroll", type=int, default=0)
     p.add_argument("--remat_window", type=int, default=-1)
+    p.add_argument("--grad_accum_steps", type=int, default=1)
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -57,11 +58,13 @@ def main():
     if args.batch_size:
         kw["batch_size"] = args.batch_size
     from bench import resolve_bench_knobs
+    if args.grad_accum_steps > 1:
+        kw["grad_accum_steps"] = args.grad_accum_steps
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
         args.remat_policy, args.preset,
-        other_explicit=bool(args.batch_size))
+        other_explicit=bool(args.batch_size) or args.grad_accum_steps > 1)
     cfg = Config(num_classes=1000, warmup_steps=0,
                  remat_policy=args.remat_policy,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,

@@ -39,9 +39,11 @@ class Config:
     num_blocks: int = 32
     mlp_ratio: float = 4.0
     pos_dropout: float = 0.0
-    # NOTE: att_dropout > 0 routes *training* attention through the dense
-    # O(N^2) path — the Pallas kernels have no dropout hook (a startup warning
-    # is printed; see vitax/ops/attention.py make_attention_impl).
+    # NOTE: att_dropout > 0 stays on the fused kernels — every attention path
+    # (whole-N, streamed, ring/ulysses sp, and their pipeline bodies at tp=1)
+    # carries an in-kernel counter-hash dropout variant (vitax/ops/attention.py
+    # dropout_keep_mask). The one remaining dense O(N^2) surface is the
+    # pipeline body under tp > 1 (vitax/parallel/pipeline.py asserts on it).
     att_dropout: float = 0.0
     mlp_dropout: float = 0.0
     num_classes: int = 1000
@@ -63,6 +65,9 @@ class Config:
 
     # --- vitax: TPU-native extensions (all default to reference-equivalent behavior) ---
     seed: int = 0
+    grad_accum_steps: int = 1           # K > 1: lax.scan over K microbatches of B/K inside the
+    #   jitted step — one clip + AdamW update per loader batch, fp32 grad
+    #   accumulators, peak activations ~ one microbatch (vitax/train/step.py)
     dtype: str = "bfloat16"             # compute dtype; params/opt state stay float32
     use_flash_attention: bool = True    # Pallas flash-attention kernel on TPU (jnp fallback elsewhere)
     # Mesh: (dp, fsdp, tp, sp). -1 on fsdp means "all remaining devices".
@@ -73,13 +78,15 @@ class Config:
     sp_impl: str = "ring"               # ring (ppermute K/V rotation) | ulysses (all-to-all head<->token)
     pp_size: int = 1                    # pipeline stages (GPipe over the stacked layer axis; composes with dp and fsdp)
     pp_microbatches: int = 0            # GPipe microbatches per step (0 = pp_size; bubble = (S-1)/(M+S-1))
-    pp_schedule: str = "gpipe"          # gpipe (autodiff backward, O(M) live acts) | 1f1b (interleaved fwd/bwd, O(S) live acts — enables large M)
+    pp_schedule: str = "gpipe"          # gpipe (autodiff backward, O(M) live acts) | 1f1b (interleaved
+                                        #   fwd/bwd, O(S) live acts — enables large M)
     ep_size: int = 1                    # expert-parallel axis (also carries batch; experts sharded across it)
     moe_experts: int = 0                # 0 = dense reference MLP; >0 = top-1 MoE in every block
     moe_capacity_factor: float = 1.25   # static expert capacity C = ceil(cf * tokens / experts)
     moe_top_k: int = 1                  # 1 = Switch (top-1); 2 = GShard-style top-2 with renormalized gates
     moe_aux_weight: float = 0.01        # load-balance aux loss weight (Switch Transformer)
-    moe_impl: str = "einsum"            # einsum (GShard one-hot — measured fastest on v5e) | gather (slot-index scatter + gathers; measured -23%, kept as the A/B arm)
+    moe_impl: str = "einsum"            # einsum (GShard one-hot — measured fastest on v5e) | gather
+                                        #   (slot-index scatter + gathers; measured -23%, kept as the A/B arm)
     scan_blocks: bool = True            # lax.scan over stacked block params (one compile for L blocks)
     scan_unroll: int = 1                # blocks per scan step: >1 frees XLA to fuse across blocks
     #   (the scan's per-block dus-stacking constrains wgrad fusion layouts —
@@ -118,6 +125,25 @@ class Config:
             f"embed_dim {self.embed_dim} not divisible by num_heads {self.num_heads}")
         assert self.sp_impl in ("ring", "ulysses"), (
             f"unknown sp_impl {self.sp_impl!r} (expected 'ring' or 'ulysses')")
+        for name in ("pos_dropout", "att_dropout", "mlp_dropout"):
+            rate = getattr(self, name)
+            assert 0.0 <= rate < 1.0, (
+                f"--{name} must be in [0, 1), got {rate}: rate >= 1 would "
+                f"zero every activation and the kernels' 1/(1-rate) rescale "
+                f"turns that into inf/NaN rather than torch's all-zeros")
+        assert self.grad_accum_steps >= 1, (
+            f"--grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
+        if self.grad_accum_steps > 1:
+            assert self.batch_size % self.grad_accum_steps == 0, (
+                f"--batch_size {self.batch_size} not divisible by "
+                f"--grad_accum_steps {self.grad_accum_steps}: the global "
+                f"batch is reshaped to (K, B/K, ...) inside the step")
+            assert self.pp_size == 1, (
+                "--grad_accum_steps > 1 with --pp_size > 1 is rejected: the "
+                "pipeline already microbatches the step (--pp_microbatches) "
+                "and nesting a second accumulation scan around it would "
+                "double-count the memory/bubble trade — raise "
+                "--pp_microbatches instead")
         assert self.scan_unroll >= 1, (
             f"--scan_unroll must be >= 1, got {self.scan_unroll}")
         if self.remat_window > 1:
@@ -225,6 +251,7 @@ def build_parser() -> argparse.ArgumentParser:
     # vitax extensions
     ext = parser.add_argument_group("vitax")
     ext.add_argument("--seed", type=int, default=0)
+    ext.add_argument("--grad_accum_steps", type=int, default=1)
     ext.add_argument("--dtype", type=str, default="bfloat16", choices=["bfloat16", "float32"])
     ext.add_argument("--no_flash_attention", action="store_false", dest="use_flash_attention")
     ext.add_argument("--dp_size", type=int, default=1)

@@ -518,8 +518,13 @@ def make_windowed_forward(cfg: Config, model: "VisionTransformer"):
         logits = apply_tail(p, x, num_classes=cfg.num_classes, dtype=dtype)
         if not with_aux:
             return logits
-        from vitax.train.step import aux_from_frac_prob
         fracs, probs = aux_stacks  # (groups, w, E) each
+        if with_aux == "raw":
+            # grad-accum microbatching needs the UNCOMBINED ingredients: the
+            # load-balance product is taken after averaging them across
+            # microbatches (vitax/train/step.py)
+            return logits, ((fracs,), (probs,))
+        from vitax.train.step import aux_from_frac_prob
         return logits, aux_from_frac_prob([fracs], [probs], cfg)
 
     return forward

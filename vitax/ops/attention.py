@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
-from vitax.parallel.mesh import BATCH_AXES
+from vitax.parallel.mesh import BATCH_AXES, shard_map
 
 MAX_SEQ_IN_VMEM = 2048  # (N, N) f32 scores: 16 MB at 2048 — VMEM ceiling
 
@@ -824,6 +824,30 @@ def _tpu_dropout_kernel(cfg, n: int, force: bool = False,
     return dropstream
 
 
+def make_dense_dropout(rate: float):
+    """Dense jnp full-sequence attention with the shared counter-hash dropout
+    mask: (q, k, v, seed) -> o on (B, N, H, Dh). The off-TPU/kernels-disabled
+    analog of _tpu_dropout_kernel — ring sp keeps a dense block product for
+    the same purpose (_dense_block_drop); this gives the ulysses flavor the
+    same anywhere-runnable dropout inner (ADVICE r5), with the same mask
+    decisions at the same local (b*H + h, q, k) coordinates as the kernels
+    (timm semantics: mask the softmax probabilities, rescale by 1/(1-rate))."""
+    def dense_drop(q, k, v, seed):
+        b, n, h, dh = q.shape
+        scale = dh ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        p = jax.nn.softmax(s, axis=-1)
+        bh = jnp.arange(b * h, dtype=jnp.uint32)
+        mask = jax.vmap(
+            lambda i: dropout_keep_mask(seed, i, n, n, rate))(bh)
+        o = jnp.einsum("bhqk,bkhd->bqhd",
+                       p * mask.reshape(b, h, n, n) / (1.0 - rate),
+                       v.astype(jnp.float32))
+        return o.astype(q.dtype)
+    return dense_drop
+
+
 def _select_path(n: int, h: int, dh: int, itemsize: int) -> str:
     """THE kernel-selection policy, shared by full-sequence dispatch
     (_tpu_kernel) and ring attention's local block products
@@ -1002,6 +1026,12 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
                 drop_inner = _tpu_dropout_kernel(
                     cfg, n, force=force_tpu_kernels,
                     local_heads=cfg.num_heads // (sp * tp))
+                if drop_inner is None and cfg.att_dropout > 0.0:
+                    # off-TPU / kernels disabled: dense inner with the same
+                    # counter-hash mask, so BOTH sp flavors carry a dropout
+                    # impl everywhere ring does — incl. the pp body at tp=1
+                    # (ADVICE r5; ring's _dense_block_drop counterpart)
+                    drop_inner = make_dense_dropout(float(cfg.att_dropout))
                 if drop_inner is not None:
                     # sp with fused dropout (round 5): the resharded inner
                     # kernel runs the in-kernel mask on its full-sequence
@@ -1074,7 +1104,7 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
             # decorrelate masks, so the raw kernel applies as-is
         return impl
     spec = P(BATCH_AXES, None, "tp", None)  # (B, N, H, Dh)
-    wrapped = _named(jax.shard_map(
+    wrapped = _named(shard_map(
         kernel, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
@@ -1087,7 +1117,7 @@ def make_attention_impl(cfg, mesh: Optional[Mesh] = None,
             return drop_kernel(q, k, v, fold_shard_seed(mesh, shard_axes,
                                                         seed))
 
-        wrapped.vitax_dropout = jax.shard_map(
+        wrapped.vitax_dropout = shard_map(
             drop_body, mesh=mesh,
             in_specs=(spec, spec, spec, P()), out_specs=spec,
             check_vma=False,

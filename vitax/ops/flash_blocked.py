@@ -34,6 +34,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from vitax.ops.attention import _interpret, dropout_keep_mask
 
+# jax < 0.5 names this TPUCompilerParams; same fields, renamed at 0.5
+if not hasattr(pltpu, "CompilerParams"):
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 NEG_INF = -1e30  # large-but-finite: avoids inf-inf=nan in max/exp chains
 
 """Measured block defaults (round-5 ladder, tools/long_context_ladder.py ->

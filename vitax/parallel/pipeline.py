@@ -82,7 +82,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.config import Config
-from vitax.parallel.mesh import BATCH_AXES
+from vitax.parallel.mesh import BATCH_AXES, optimization_barrier, shard_map
 
 
 def _gather_over(x, spec: P, axis_name: str):
@@ -216,7 +216,7 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
                 # once (28.7 GB vs 10.1 GB temps at the 10B flagship shape —
                 # caught by test_10b_shape_lowers_under_pipeline_fsdp). The
                 # barrier makes the gather input depend on the loop carry.
-                layer_params, carry = jax.lax.optimization_barrier(
+                layer_params, carry = optimization_barrier(
                     (layer_params, carry))
                 # ZeRO-3 inside the pipeline: gather this block's shards over
                 # "fsdp" just-in-time (under remat this sits inside the
@@ -316,10 +316,12 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
             acc0 = (jnp.zeros((Lps, cfg.moe_experts), jnp.float32),) * 2 \
                 if collect_aux else (jnp.float32(0.0),) * 2
             buf0 = jnp.zeros_like(mbs[0])
-            if tp_auto:
+            if tp_auto and hasattr(jax.lax, "pcast"):
                 # under vma tracking (the partial-manual tp path) the
                 # carry's type must declare it varies over pp — the tick
-                # output does (each stage holds a different activation)
+                # output does (each stage holds a different activation).
+                # jax 0.4.x has no vma tracking (check_rep=False on the
+                # partial-auto path), so there is nothing to cast there.
                 buf0 = jax.lax.pcast(buf0, ("pp",), to="varying")
             (_, acc_f, acc_p), ys = jax.lax.scan(
                 tick, (buf0, *acc0),
@@ -388,7 +390,7 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
         # everything BUT tp and turn vma tracking ON — the residual
         # out_specs must then be inferred precisely, since naming an auto
         # axis in out_specs is an error.
-        run = jax.shard_map(
+        run = shard_map(
             pipeline_body, mesh=mesh,
             in_specs=(in_specs, P(), act_spec),
             out_specs=(act_spec, P()),

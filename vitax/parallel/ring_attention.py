@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from vitax.parallel.mesh import BATCH_AXES
+from vitax.parallel.mesh import BATCH_AXES, axis_size, shard_map
 
 
 def _dense_block(q, k, v, scale: float):
@@ -88,7 +88,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
     (q, k, v, scale) products. ONE copy of the ring machinery — the
     prefetch-before-compute ordering below is load-bearing for the
     latency hiding described in the module docstring."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     # K and V ride ONE stacked (2, B, N_loc, H, Dh) buffer so each ring step
@@ -127,7 +127,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
 
     def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         scale = q.shape[-1] ** -0.5
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(_ring_attention_local, axis_name=axis_name,
                               scale=scale, block_fn=block_fn),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -185,7 +185,7 @@ def _ring_attention_local_drop(q, k, v, seed, *, axis_name: str,
     per-step seedvec differs."""
     from vitax.ops.attention import _seedvec
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     n_loc = q.shape[1]
     q0 = idx.astype(jnp.int32) * n_loc
@@ -228,7 +228,7 @@ def make_ring_dropout(mesh: Mesh, rate: float, axis_name: str = "sp",
                 q, k, v, seed, axis_name=axis_name, scale=scale, rate=rate,
                 block_fn=block_fn)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec, P()),
             out_specs=spec, check_vma=False,
         )

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.ops.attention import reference_attention
-from vitax.parallel.mesh import BATCH_AXES
+from vitax.parallel.mesh import BATCH_AXES, shard_map
 
 
 def _ulysses_local(q, k, v, inner: Callable, axis_name: str):
@@ -62,7 +62,7 @@ def make_ulysses_attention(mesh: Mesh, inner: Optional[Callable] = None,
     inner = inner if inner is not None else reference_attention
 
     def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(_ulysses_local, inner=inner, axis_name=axis_name),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
@@ -98,7 +98,7 @@ def make_ulysses_dropout(mesh: Mesh, inner_drop: Callable,
             axis_name=axis_name)
 
     def ulysses_dropout(q, k, v, seed):
-        fn = jax.shard_map(
+        fn = shard_map(
             body, mesh=mesh,
             in_specs=(spec, spec, spec, P()), out_specs=spec,
             check_vma=False,

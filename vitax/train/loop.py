@@ -114,6 +114,14 @@ def train(cfg: Config) -> TrainState:
         f"clip_grad_norm={cfg.clip_grad_norm})\n")
     distributed.barrier("loaded optimizer")
 
+    if cfg.grad_accum_steps > 1:
+        # step-count/logging semantics are UNCHANGED: the scan over K
+        # microbatches lives inside the compiled step, so each loader batch
+        # is still exactly one optimizer step / one log line / one lr tick.
+        master_print(
+            f"grad accumulation: {cfg.grad_accum_steps} microbatches of "
+            f"{cfg.batch_size // cfg.grad_accum_steps} inside the jitted "
+            f"step (one optimizer step per loader batch)")
     train_step = make_train_step(cfg, model, tx, mesh, state_specs)
     eval_step = make_eval_step(cfg, model, mesh, state_specs)
 

@@ -18,7 +18,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from vitax.config import Config
-from vitax.parallel.mesh import Mesh, batch_pspec
+from vitax.parallel.mesh import BATCH_AXES, Mesh, batch_pspec
 from vitax.parallel.sharding import gather_over_fsdp, shardings_of
 from vitax.train.state import TrainState
 
@@ -90,6 +90,10 @@ def _forward_fn(cfg: Config, model, mesh: Mesh, state_specs=None):
                                    mutable=["intermediates"])
         fracs = _select_by_name(cols, "moe_frac_tokens")
         probs = _select_by_name(cols, "moe_mean_prob")
+        if with_aux == "raw":
+            # uncombined per-block ingredients, for callers that average
+            # them across grad-accum microbatches BEFORE the product
+            return logits, (tuple(fracs), tuple(probs))
         return logits, aux_from_frac_prob(fracs, probs, cfg)
 
     return forward
@@ -108,6 +112,26 @@ def prepare_images(images: jax.Array) -> jax.Array:
     return (images.astype(jnp.float32) / 255.0 - mean) / std
 
 
+def _microbatch_split(batch: PyTree, k_steps: int, mesh: Mesh) -> PyTree:
+    """Reshape every (B, ...) leaf to (K, B/K, ...) with a STRIDED sample
+    assignment: reshape to (B/K, K, ...) then swap the leading axes, so
+    microbatch k holds samples {k, k + K, k + 2K, ...}. Under the batch
+    sharding, element (j, k) = sample j*K + k stays inside the owning
+    device's contiguous [d*B/D, (d+1)*B/D) range — the split costs no
+    cross-device data movement. CE and the MoE router/aux ingredients are
+    per-sample, so WHICH samples share a microbatch cannot change the
+    summed gradient."""
+    def split(x):
+        xs = x.reshape(x.shape[0] // k_steps, k_steps, *x.shape[1:])
+        xs = xs.swapaxes(0, 1)
+        if mesh.size > 1:
+            spec = P(None, batch_pspec()[0], *(None,) * (x.ndim - 1))
+            xs = jax.lax.with_sharding_constraint(
+                xs, NamedSharding(mesh, spec))
+        return xs
+    return jax.tree.map(split, batch)
+
+
 def make_train_step(
     cfg: Config,
     model,
@@ -122,6 +146,13 @@ def make_train_step(
       fully-gathered (over "fsdp") layout at the top of the step, so the
       all-gather happens once and the gathered weights stay live through
       backward; grads and optimizer state remain sharded.
+    - `--grad_accum_steps K > 1`: a lax.scan over K microbatches of B/K
+      accumulates fp32 grads inside this same compiled program — one clip +
+      AdamW update (and one loss/grad_norm metric) per loader batch, peak
+      activations ~ one microbatch. The ZeRO-2 gather above happens ONCE
+      (scan-invariant) and is reused by all K microbatches. K == 1 traces
+      the exact pre-accumulation program (no scan wrapper, no extra rng
+      fold) — the compiled step is unchanged.
     """
     state_shardings = shardings_of(mesh, state_specs)
     batch_sharding = NamedSharding(mesh, batch_pspec())
@@ -162,6 +193,92 @@ def make_train_step(
         from vitax.parallel.pipeline_1f1b import make_1f1b_value_and_grad
         vag_1f1b = make_1f1b_value_and_grad(cfg, model, mesh, state_specs)
 
+    k_steps = int(getattr(cfg, "grad_accum_steps", 1) or 1)
+    if k_steps > 1:
+        assert not use_1f1b and getattr(cfg, "pp_size", 1) == 1, (
+            "grad accumulation under pipeline parallelism is rejected by "
+            "Config.validate()")
+        assert cfg.batch_size % k_steps == 0, (cfg.batch_size, k_steps)
+        batch_devices = 1
+        for ax in BATCH_AXES:
+            batch_devices *= mesh.shape.get(ax, 1)
+        assert (cfg.batch_size // k_steps) % batch_devices == 0, (
+            f"microbatch {cfg.batch_size}/{k_steps} = "
+            f"{cfg.batch_size // k_steps} not divisible by the "
+            f"{batch_devices} batch-sharding devices (dp x fsdp x ep)")
+        # grads accumulate at the SHARDED param layout (fp32): each
+        # microbatch's backward reduce-scatters into the accumulator rather
+        # than holding a gathered grad tree live — under ZeRO-2 the gathered
+        # layout applies to params only.
+        accum_shardings = state_shardings.params
+
+    def accum_value_and_grad_dense(params, mbs, step_rng):
+        """Manual accumulation (dense objective): per-microbatch
+        value_and_grad inside the scan body — backward runs per iteration,
+        so residuals live for ONE microbatch — summed into an fp32 carry.
+        Exact vs K=1 by linearity of the gradient in the loss mean."""
+        grad0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             params)
+
+        def accum(carry, xs):
+            gsum, loss_sum = carry
+            mb, k = xs
+            loss_k, g_k = jax.value_and_grad(loss_fn)(
+                params, mb, jax.random.fold_in(step_rng, k))
+            gsum = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                gsum, g_k)
+            if mesh.size > 1:
+                gsum = jax.lax.with_sharding_constraint(
+                    gsum, accum_shardings)
+            return (gsum, loss_sum + loss_k), None
+
+        if mesh.size > 1:
+            grad0 = jax.lax.with_sharding_constraint(grad0, accum_shardings)
+        (gsum, loss_sum), _ = jax.lax.scan(
+            accum, (grad0, jnp.zeros((), jnp.float32)),
+            (mbs, jnp.arange(k_steps, dtype=jnp.uint32)))
+        scale = 1.0 / k_steps
+        return loss_sum * scale, jax.tree.map(lambda g: g * scale, gsum)
+
+    def accum_loss_moe(params, mbs, step_rng):
+        """MoE objective, differentiated THROUGH the microbatch scan: the
+        load-balance aux couples microbatches (its ingredients are
+        full-batch means taken before the frac*prob product), so the exact
+        full-batch gradient cannot be formed one microbatch at a time. The
+        scan body emits per-microbatch CE and RAW aux ingredients as
+        stacked outputs; the objective combines their means AFTER the scan
+        — identical to K=1 up to fp reassociation. jax.checkpoint on the
+        body keeps residuals at one microbatch (the backward recomputes
+        each microbatch's forward — ~+1F vs the dense manual path)."""
+        def mb_terms(p, mb, k):
+            images = prepare_images(mb["image"])
+            r = jax.random.fold_in(step_rng, k) if dropout else None
+            logits, (fracs, probs) = forward(p, images, not dropout, rng=r,
+                                             with_aux="raw")
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                anchor_logits(logits), mb["label"]).mean()
+            return ce, fracs, probs
+
+        mb_ckpt = jax.checkpoint(mb_terms, prevent_cse=False)
+
+        def body(carry, xs):
+            mb, k = xs
+            return carry, mb_ckpt(params, mb, k)
+
+        _, (ces, frac_stacks, prob_stacks) = jax.lax.scan(
+            body, jnp.zeros((), jnp.float32),
+            (mbs, jnp.arange(k_steps, dtype=jnp.uint32)))
+        fracs = [jnp.mean(f, axis=0) for f in frac_stacks]
+        probs = [jnp.mean(p, axis=0) for p in prob_stacks]
+        return (jnp.mean(ces)
+                + cfg.moe_aux_weight * aux_from_frac_prob(fracs, probs, cfg))
+
+    def accum_value_and_grad(params, batch, step_rng):
+        mbs = _microbatch_split(batch, k_steps, mesh)
+        if moe:
+            return jax.value_and_grad(accum_loss_moe)(params, mbs, step_rng)
+        return accum_value_and_grad_dense(params, mbs, step_rng)
+
     def train_step(state: TrainState, batch, rng):
         step_rng = jax.random.fold_in(rng, state.step)
         if zero2:
@@ -171,6 +288,8 @@ def make_train_step(
         if use_1f1b:
             loss, grads = vag_1f1b(params, prepare_images(batch["image"]),
                                    batch["label"])
+        elif k_steps > 1:
+            loss, grads = accum_value_and_grad(params, batch, step_rng)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, batch, step_rng)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
