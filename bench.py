@@ -797,6 +797,10 @@ def bench_train(args, metric_stub: str) -> None:
         kw["att_dropout"] = args.att_dropout
     if args.grad_accum_steps > 1:
         kw["grad_accum_steps"] = args.grad_accum_steps
+    if args.param_gather_dtype:
+        kw["param_gather_dtype"] = args.param_gather_dtype
+    if args.grad_reduce_dtype != "float32":
+        kw["grad_reduce_dtype"] = args.grad_reduce_dtype
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
@@ -805,7 +809,9 @@ def bench_train(args, metric_stub: str) -> None:
                         or bool(args.batch_size)
                         or args.moe_impl is not None
                         or args.att_dropout is not None
-                        or args.grad_accum_steps > 1))
+                        or args.grad_accum_steps > 1
+                        or args.param_gather_dtype is not None
+                        or args.grad_reduce_dtype != "float32"))
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll, remat_window=args.remat_window,
@@ -852,7 +858,8 @@ def bench_train(args, metric_stub: str) -> None:
     base_entry = read_baseline().get(args.preset, {})
     knobs = ("batch_size", "remat_policy", "scan_blocks", "scan_unroll",
              "remat_window", "grad_ckpt", "use_flash_attention",
-             "moe_impl", "att_dropout", "grad_accum_steps")
+             "moe_impl", "att_dropout", "grad_accum_steps",
+             "param_gather_dtype", "grad_reduce_dtype")
     # compare only like-for-like: a knob change (e.g. the scan->unrolled
     # default flip) must not masquerade as a same-config speedup. Entries
     # written before a knob existed compare at the Config FIELD DEFAULT —
@@ -886,7 +893,31 @@ def bench_train(args, metric_stub: str) -> None:
             "moe_impl": cfg.moe_impl,
             "att_dropout": cfg.att_dropout,
             "grad_accum_steps": cfg.grad_accum_steps,
+            "param_gather_dtype": cfg.param_gather_dtype,
+            "grad_reduce_dtype": cfg.grad_reduce_dtype,
         })
+
+    # optional collective audit: same report as `tools/comm_audit.py --json`,
+    # landed in the BENCH payload next to the perf knobs so a measured number
+    # always records what dtype its collectives moved (ISSUE: comm-precision
+    # observability). Costs one extra AOT compile, hence opt-in.
+    comm = None
+    if args.comm_audit:
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import comm_audit as comm_audit_mod
+            rep = comm_audit_mod.audit_config(cfg)
+            comm = {
+                "param_gather_dtype": cfg.resolved_param_gather_dtype,
+                "grad_reduce_dtype": cfg.grad_reduce_dtype,
+                "all_gather_bytes": rep["all_gather_bytes"],
+                "collective_bytes": {
+                    op: t["bytes"] for op, t in rep["totals"].items()},
+                "f32_block_param_gathers": len(rep["f32_block_param_gathers"]),
+            }
+        except Exception as e:  # audit must never sink a measured number
+            comm = {"error": f"{type(e).__name__}: {e}"}
 
     emit({
         "metric": f"images/sec/chip (ViT-{args.preset}, train step, "
@@ -905,7 +936,10 @@ def bench_train(args, metric_stub: str) -> None:
                   "scan_blocks": cfg.scan_blocks,
                   "scan_unroll": cfg.scan_unroll,
                   "remat_window": cfg.remat_window,
-                  "grad_accum_steps": cfg.grad_accum_steps},
+                  "grad_accum_steps": cfg.grad_accum_steps,
+                  "param_gather_dtype": cfg.resolved_param_gather_dtype,
+                  "grad_reduce_dtype": cfg.grad_reduce_dtype},
+        **({"comm": comm} if comm is not None else {}),
     })
 
 
@@ -951,6 +985,20 @@ def main():
                         "presets; an explicit A/B knob like --batch_size)")
     p.add_argument("--att_dropout", type=float, default=None,
                    help="attention-dropout A/B arm (in-kernel dropout path)")
+    p.add_argument("--param_gather_dtype", default=None,
+                   choices=["bfloat16", "float32"],
+                   help="comm-precision A/B arm: dtype the FSDP param "
+                        "collectives move (None = Config default: follow "
+                        "--dtype, i.e. bf16 gathers on the bf16 presets)")
+    p.add_argument("--grad_reduce_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="comm-precision A/B arm: dtype the grad "
+                        "reduce-scatter/all-reduce moves (float32 = exact "
+                        "pre-policy numerics)")
+    p.add_argument("--comm_audit", action="store_true",
+                   help="embed the tools/comm_audit.py collective report "
+                        "(op/dtype/bytes per step) in the BENCH payload; "
+                        "costs one extra AOT compile")
     p.add_argument("--no_flash_attention", action="store_false",
                    dest="use_flash_attention")
     p.add_argument("--steps", type=int, default=30)

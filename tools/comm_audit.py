@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""Audit the collectives the compiled train step moves on the wire.
+
+AOT-compiles the train step for the given config (any trainer flag works —
+the CLI is the full vitax flag surface plus the audit flags below), dumps the
+HLO right after SPMD partitioning, and tabulates every collective op
+(all-gather / reduce-scatter / all-reduce / all-to-all / collective-permute):
+op count, element type, shape, and bytes per step. This is the artifact that
+proves the `--param_gather_dtype bfloat16` policy halves FSDP gather traffic
+and guards against precision regressions (tests/test_comm_precision.py).
+
+Why the *post-partitioning* dump and not the final executable HLO: backend
+simplification passes may rewrite collective element types after SPMD
+partitioning. XLA:CPU's float normalization in particular rewrites every bf16
+collective as an f32 collective wrapped in converts, so the final CPU HLO can
+never show a bf16 gather no matter what the program asked for. The
+post-`spmd-partitioning` module is the backend-independent ground truth for
+what dtype each collective moves.
+
+Known result worth recording: under ZeRO-3 (reshard_after_forward) GSPMD sinks
+the compute-dtype convert below the per-use gathers, so per-block all-gathers
+are bf16 even under the f32 policy — the byte delta of the bf16 policy shows
+at the ZeRO-2 step-top gather of the whole param tree (~2x total gather
+bytes), plus once-per-step casting and bf16 scan carries instead of per-slice
+converts.
+
+Usage:
+    python tools/comm_audit.py --embed_dim 1024 --num_blocks 24 [vitax flags]
+    python tools/comm_audit.py ... --json          # machine-readable report
+    python tools/comm_audit.py ... --compare       # vs the f32 gather policy
+"""
+
+import collections
+import glob
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# `= bf16[2,32,128]{...} all-gather(` — dtype, shape, op from a partitioned-HLO
+# instruction line. `-start` variants cover async collectives; `-done` halves
+# carry no shape of their own and are skipped.
+COLLECTIVE_RE = re.compile(
+    r"= (\w+)\[([\d,]*)\][^ ]* "
+    r"((?:all-gather|all-reduce|reduce-scatter|all-to-all|collective-permute)"
+    r"(?:-start)?)\(")
+
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f16": 2, "bf16": 2, "s16": 2, "u16": 2,
+    "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8,
+}
+
+
+def collect_collectives(hlo_text):
+    """Parse a partitioned-HLO module into aggregated collective rows.
+
+    Returns a list of dicts {op, dtype, shape, count, bytes} where `bytes` is
+    count * output-shape bytes. Output-shape bytes is the honest per-step
+    proxy for wire traffic: an all-gather's output is the gathered tensor
+    every participant materializes, an all-reduce/reduce-scatter's output is
+    what the reduction moves. (Exact wire bytes carry an extra (n-1)/n ring
+    factor that is identical across policies and so cancels in every ratio
+    this tool is used for.)
+    """
+    rows = collections.Counter()
+    for m in COLLECTIVE_RE.finditer(hlo_text):
+        dtype, shape_s, op = m.groups()
+        shape = tuple(int(d) for d in shape_s.split(",") if d)
+        rows[(op.replace("-start", ""), dtype, shape)] += 1
+    out = []
+    for (op, dtype, shape), count in sorted(rows.items()):
+        numel = 1
+        for d in shape:
+            numel *= d
+        out.append({
+            "op": op, "dtype": dtype, "shape": list(shape), "count": count,
+            "numel": numel,
+            "bytes": count * numel * DTYPE_BYTES.get(dtype, 4),
+        })
+    return out
+
+
+def summarize(rows):
+    """Totals per op kind, split by element type."""
+    totals = {}
+    for r in rows:
+        slot = totals.setdefault(r["op"], {"count": 0, "bytes": 0, "by_dtype": {}})
+        slot["count"] += r["count"]
+        slot["bytes"] += r["bytes"]
+        d = slot["by_dtype"].setdefault(r["dtype"], {"count": 0, "bytes": 0})
+        d["count"] += r["count"]
+        d["bytes"] += r["bytes"]
+    return totals
+
+
+def gather_bytes(rows, dtype=None, min_numel=0):
+    """Total all-gather bytes, optionally filtered by dtype / operand size."""
+    return sum(r["bytes"] for r in rows
+               if r["op"] == "all-gather"
+               and (dtype is None or r["dtype"] == dtype)
+               and r["numel"] >= min_numel)
+
+
+def partitioned_hlo_text(cfg, max_iteration=10_000):
+    """AOT-lower the train step for `cfg` and return the HLO module text
+    captured right after the SPMD partitioner (see module docstring for why
+    that stage and not the final executable)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from vitax.models import build_model
+    from vitax.ops.attention import make_attention_impl
+    from vitax.parallel.mesh import batch_pspec, build_mesh
+    from vitax.train.loop import _token_sharding
+    from vitax.train.state import build_optimizer, make_train_state
+    from vitax.train.step import make_train_step
+
+    mesh = build_mesh(cfg)
+    model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh),
+                        token_sharding=_token_sharding(cfg, mesh))
+    tx, _ = build_optimizer(cfg, max_iteration=max_iteration)
+    state, sspecs, _ = make_train_state(cfg, model, tx, mesh,
+                                        jax.random.key(cfg.seed),
+                                        materialize=False)
+    step = make_train_step(cfg, model, tx, mesh, sspecs)
+    sh = NamedSharding(mesh, batch_pspec())
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.image_size, cfg.image_size, 3),
+            jnp.float32, sharding=sh),
+        "label": jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32,
+                                      sharding=sh),
+    }
+    dump_dir = tempfile.mkdtemp(prefix="comm_audit_hlo_")
+    try:
+        step.lower(state, batch, jax.random.key(cfg.seed + 1)).compile(
+            compiler_options={"xla_dump_to": dump_dir,
+                              "xla_dump_hlo_pass_re": ".*partitioning"})
+        dumps = glob.glob(os.path.join(dump_dir, "*after_spmd-partitioning*"))
+        preferred = [f for f in dumps if "train_step" in os.path.basename(f)]
+        if not preferred:  # fall back to the largest module (the step)
+            preferred = sorted(dumps, key=os.path.getsize)[-1:]
+        if not preferred:
+            if mesh.size == 1:
+                # single-device compile: the SPMD partitioner never runs, so
+                # there is no dump — and no collectives to audit either
+                return ""
+            raise RuntimeError(
+                f"no post-partitioning HLO dump appeared in {dump_dir}; "
+                "this XLA build may not honour per-compile xla_dump_to")
+        with open(preferred[0], encoding="utf-8") as f:
+            return f.read()
+    finally:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+
+
+def audit_config(cfg):
+    """Full audit report for one config: collective rows + per-op totals +
+    the block-param gather facts the tier-1 test asserts on."""
+    rows = collect_collectives(partitioned_hlo_text(cfg))
+    block_numel = cfg.embed_dim * cfg.embed_dim  # smallest block matmul param
+    return {
+        "config": {
+            "dtype": cfg.dtype,
+            "param_gather_dtype": cfg.resolved_param_gather_dtype,
+            "grad_reduce_dtype": cfg.grad_reduce_dtype,
+            "reshard_after_forward": cfg.reshard_after_forward,
+            "run_without_fsdp": cfg.run_without_fsdp,
+            "grad_accum_steps": cfg.grad_accum_steps,
+            "pp_size": cfg.pp_size,
+        },
+        "collectives": rows,
+        "totals": summarize(rows),
+        "all_gather_bytes": gather_bytes(rows),
+        "f32_block_param_gathers": [
+            r for r in rows
+            if r["op"] == "all-gather" and r["dtype"] == "f32"
+            and r["numel"] >= block_numel],
+    }
+
+
+def format_report(report):
+    lines = []
+    c = report["config"]
+    lines.append(f"comm_audit: dtype={c['dtype']} "
+                 f"param_gather_dtype={c['param_gather_dtype']} "
+                 f"grad_reduce_dtype={c['grad_reduce_dtype']}")
+    lines.append(f"{'count':>6} {'op':<20} {'dtype':<6} {'bytes':>12}  shape")
+    for r in report["collectives"]:
+        lines.append(f"{r['count']:>6} {r['op']:<20} {r['dtype']:<6} "
+                     f"{r['bytes']:>12,}  {r['shape']}")
+    lines.append("-- totals --")
+    for op, t in sorted(report["totals"].items()):
+        split = ", ".join(f"{d}: {v['bytes']:,}B x{v['count']}"
+                          for d, v in sorted(t["by_dtype"].items()))
+        lines.append(f"  {op:<20} {t['bytes']:>12,} B/step  ({split})")
+    bad = report["f32_block_param_gathers"]
+    lines.append(f"  f32 block-param all-gathers: "
+                 f"{len(bad)}{' <- POLICY NOT APPLIED' if bad else ''}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    from vitax.config import build_parser, config_fields_from_namespace
+
+    parser = build_parser()
+    aud = parser.add_argument_group("comm_audit")
+    aud.add_argument("--json", action="store_true", dest="audit_json",
+                     help="emit the audit report as JSON on stdout")
+    aud.add_argument("--compare", action="store_true", dest="audit_compare",
+                     help="also audit the same config under the f32 gather "
+                          "policy and report the gather-byte ratio")
+    # audit runs standalone on dev boxes: small default geometry instead of
+    # the 10B trainer defaults so `python tools/comm_audit.py` just works
+    parser.set_defaults(image_size=224, patch_size=14, embed_dim=1024,
+                        num_heads=16, num_blocks=4, num_classes=1000,
+                        batch_size=64, warmup_steps=2)
+    ns = parser.parse_args(argv)
+
+    from vitax.config import Config
+    cfg = Config(**config_fields_from_namespace(ns)).validate()
+    report = audit_config(cfg)
+
+    if ns.audit_compare:
+        alt = {**config_fields_from_namespace(ns),
+               "param_gather_dtype": "float32"}
+        f32_report = audit_config(Config(**alt).validate())
+        num = f32_report["all_gather_bytes"]
+        den = report["all_gather_bytes"]
+        report["compare"] = {
+            "f32_policy_all_gather_bytes": num,
+            "all_gather_bytes_ratio": round(num / den, 3) if den else None,
+        }
+
+    if ns.audit_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_report(report))
+        if "compare" in report:
+            cmp_ = report["compare"]
+            print(f"-- vs f32 gather policy --\n"
+                  f"  f32-policy all-gather bytes: "
+                  f"{cmp_['f32_policy_all_gather_bytes']:,}\n"
+                  f"  gather-byte reduction: "
+                  f"{cmp_['all_gather_bytes_ratio']}x")
+    return report
+
+
+if __name__ == "__main__":
+    main()

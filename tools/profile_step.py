@@ -33,6 +33,13 @@ def main():
     p.add_argument("--scan_unroll", type=int, default=0)
     p.add_argument("--remat_window", type=int, default=-1)
     p.add_argument("--grad_accum_steps", type=int, default=1)
+    p.add_argument("--param_gather_dtype", default=None,
+                   choices=["bfloat16", "float32"],
+                   help="comm-precision A/B: dtype the FSDP param gathers "
+                        "move (None = follow --dtype)")
+    p.add_argument("--grad_reduce_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="comm-precision A/B: dtype the grad reduction moves")
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -65,6 +72,10 @@ def main():
         args.scan_blocks, args.scan_unroll, args.remat_window,
         args.remat_policy, args.preset,
         other_explicit=bool(args.batch_size) or args.grad_accum_steps > 1)
+    if args.param_gather_dtype:
+        kw["param_gather_dtype"] = args.param_gather_dtype
+    if args.grad_reduce_dtype != "float32":
+        kw["grad_reduce_dtype"] = args.grad_reduce_dtype
     cfg = Config(num_classes=1000, warmup_steps=0,
                  remat_policy=args.remat_policy,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,

@@ -69,6 +69,18 @@ class Config:
     #   jitted step — one clip + AdamW update per loader batch, fp32 grad
     #   accumulators, peak activations ~ one microbatch (vitax/train/step.py)
     dtype: str = "bfloat16"             # compute dtype; params/opt state stay float32
+    # Communication precision (vitax/parallel/sharding.py cast_to_compute):
+    #   param_gather_dtype: dtype the FSDP collectives move for params. None
+    #   resolves to --dtype, so the default bf16 run gathers bf16 (half the
+    #   collective bytes) while --dtype float32 runs are untouched. Casting the
+    #   *shards* before the gather commutes with the gather, so the forward is
+    #   bitwise-identical to gather-then-cast; master params stay f32.
+    #   grad_reduce_dtype: dtype the grad reduce-scatter / all-reduce moves.
+    #   float32 (default) upcasts each device's bf16 partial before the
+    #   reduction — exactly the current numerics; bfloat16 pins the reduction
+    #   on bf16 bits for another 2x on grad comm (opt-in precision trade).
+    param_gather_dtype: Optional[str] = None  # None -> follow --dtype
+    grad_reduce_dtype: str = "float32"
     use_flash_attention: bool = True    # Pallas flash-attention kernel on TPU (jnp fallback elsewhere)
     # Mesh: (dp, fsdp, tp, sp). -1 on fsdp means "all remaining devices".
     dp_size: int = 1
@@ -109,6 +121,16 @@ class Config:
     steps_per_epoch: int = 0            # override (0 = derive from dataset length // batch_size)
     max_steps: int = 0                  # hard stop after N optimizer steps (0 = no limit; for smoke/bench)
     eval_max_batches: int = 0           # cap val batches per eval (0 = full split, reference behavior)
+
+    @property
+    def resolved_param_gather_dtype(self) -> str:
+        """Gather-dtype policy after None -> --dtype resolution."""
+        return self.param_gather_dtype or self.dtype
+
+    @property
+    def comm_cast_active(self) -> bool:
+        """True when params should be downcast (sharded) before FSDP gathers."""
+        return self.dtype == "bfloat16" and self.resolved_param_gather_dtype == "bfloat16"
 
     @property
     def num_patches(self) -> int:
@@ -208,6 +230,21 @@ class Config:
                 f"--moe_top_k {self.moe_top_k} > --moe_experts "
                 f"{self.moe_experts}: the second choice would be a dead "
                 f"branch with gate ~0")
+        assert self.resolved_param_gather_dtype in ("bfloat16", "float32"), (
+            f"unknown param_gather_dtype {self.param_gather_dtype!r}")
+        assert self.grad_reduce_dtype in ("bfloat16", "float32"), (
+            f"unknown grad_reduce_dtype {self.grad_reduce_dtype!r}")
+        if self.dtype == "float32":
+            assert self.param_gather_dtype != "bfloat16", (
+                "--param_gather_dtype bfloat16 with --dtype float32 would gather a "
+                "downcast tree into an f32 model and silently change compute "
+                "precision; use --dtype bfloat16 (f32 master params are kept "
+                "either way)")
+        if self.grad_reduce_dtype == "bfloat16":
+            assert self.comm_cast_active, (
+                "--grad_reduce_dtype bfloat16 requires the bf16 comm-cast to be "
+                "active (--dtype bfloat16 and param_gather_dtype bfloat16): the "
+                "bf16 reduction rides the cast boundary")
         return self
 
 
@@ -253,6 +290,23 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--seed", type=int, default=0)
     ext.add_argument("--grad_accum_steps", type=int, default=1)
     ext.add_argument("--dtype", type=str, default="bfloat16", choices=["bfloat16", "float32"])
+    ext.add_argument("--param_gather_dtype", type=str, default=None,
+                     choices=["bfloat16", "float32"],
+                     help="dtype the FSDP param collectives (ZeRO-3 per-block "
+                          "all-gathers, the ZeRO-2 step-top gather, pipeline "
+                          "in-body gathers) move on the wire. Default: follow "
+                          "--dtype, i.e. bf16 runs gather bf16 (2x fewer bytes, "
+                          "bitwise-identical forward: casting shards commutes "
+                          "with the gather); float32 forces the pre-PR f32 "
+                          "gathers. Rejected with --dtype float32.")
+    ext.add_argument("--grad_reduce_dtype", type=str, default="float32",
+                     choices=["float32", "bfloat16"],
+                     help="dtype the gradient reduce-scatter / all-reduce moves. "
+                          "float32 (default) upcasts bf16 wgrad partials before "
+                          "the cross-device reduction — exact current numerics; "
+                          "bfloat16 reduces on bf16 bits for another 2x on grad "
+                          "comm (~1e-2 step agreement; needs the bf16 gather "
+                          "policy active).")
     ext.add_argument("--no_flash_attention", action="store_false", dest="use_flash_attention")
     ext.add_argument("--dp_size", type=int, default=1)
     ext.add_argument("--fsdp_size", type=int, default=-1)
@@ -287,7 +341,13 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def config_fields_from_namespace(ns: argparse.Namespace) -> dict:
+    """Config kwargs from a parsed namespace — tolerant of extra attributes,
+    so tools may extend build_parser() with their own flags and still build a
+    Config from the shared surface (tools/comm_audit.py does)."""
+    return {f.name: getattr(ns, f.name) for f in dataclasses.fields(Config)}
+
+
 def parse_config(argv: Optional[Tuple[str, ...]] = None) -> Config:
     ns = build_parser().parse_args(argv)
-    cfg = Config(**{f.name: getattr(ns, f.name) for f in dataclasses.fields(Config)})
-    return cfg.validate()
+    return Config(**config_fields_from_namespace(ns)).validate()

@@ -173,8 +173,18 @@ def make_1f1b_value_and_grad(cfg: Config, model, mesh: Mesh, state_specs):
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
 
-        g_stage0 = jax.tree.map(jnp.zeros_like, stage_params)
-        g_tail0 = jax.tree.map(jnp.zeros_like, tail_params)
+        # f32 accumulators regardless of param dtype: under the comm-precision
+        # cast (vitax/parallel/sharding.py cast_to_compute) stage params — and
+        # so the per-tick cotangents — are bf16; accumulating ticks in bf16
+        # would lose low bits. At f32 params the astype below is a no-op and
+        # the program is unchanged.
+        def _grad_zeros(p):
+            z_dtype = (jnp.float32 if jnp.issubdtype(p.dtype, jnp.floating)
+                       else p.dtype)
+            return jnp.zeros(p.shape, z_dtype)
+
+        g_stage0 = jax.tree.map(_grad_zeros, stage_params)
+        g_tail0 = jax.tree.map(_grad_zeros, tail_params)
         buf0 = jnp.zeros((W, mb, *x.shape[1:]), x.dtype)
 
         def tick(carry, t):
@@ -201,7 +211,7 @@ def make_1f1b_value_and_grad(cfg: Config, model, mesh: Mesh, state_specs):
             at_tail = jnp.logical_and(s == S - 1, valid_f)
             loss_acc = loss_acc + jnp.where(at_tail, loss_mb, 0.0)
             g_tail = jax.tree.map(
-                lambda a, g: a + jnp.where(at_tail, g, 0.0),
+                lambda a, g: a + jnp.where(at_tail, g, 0.0).astype(a.dtype),
                 g_tail, g_tail_tick)
 
             # ---- backward of microbatch b = t - (2S - 2 - s) ----
@@ -214,7 +224,7 @@ def make_1f1b_value_and_grad(cfg: Config, model, mesh: Mesh, state_specs):
             _, stage_vjp = jax.vjp(stage_fwd, stage_params, x_saved)
             g_stage_tick, dx = stage_vjp(cot_in)
             g_stage = jax.tree.map(
-                lambda a, g: a + jnp.where(valid_b, g, 0.0),
+                lambda a, g: a + jnp.where(valid_b, g, 0.0).astype(a.dtype),
                 g_stage, g_stage_tick)
             dx_out = jnp.where(jnp.logical_and(s == 0, valid_b), dx, 0.0)
 

@@ -32,12 +32,23 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from vitax.config import Config
 
 PyTree = Any
+
+# Parameters consumed at float32 by the model: the head Dense ("head + loss in
+# float32", vitax/models/vit.py), the MoE router Dense (vitax/models/moe.py),
+# and every LayerNorm's scale/bias (flax normalizes in f32 and folds the scale
+# in BEFORE casting the output to the compute dtype, so LN params never pass
+# through a bf16 cast). Downcasting them would change the math — f32(bf16(w))
+# != w — so the comm cast skips any leaf under these names. All are O(d) or
+# O(d*num_classes): their f32 gathers are noise next to the O(d^2) block
+# matrices the policy targets.
+KEEP_F32_PARAMS = ("head", "router", "norm", "norm1", "norm2")
 
 
 def _path_names(path) -> Tuple[str, ...]:
@@ -197,6 +208,122 @@ def gather_over_fsdp(specs: PyTree) -> PyTree:
     def strip(spec: P) -> P:
         return P(*[None if axis == "fsdp" else axis for axis in spec])
     return jax.tree.map(strip, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def cast_to_compute(
+    params: PyTree,
+    dtype: Any = jnp.bfloat16,
+    shardings: Optional[PyTree] = None,
+    grad_reduce_dtype: Any = jnp.float32,
+    keep_f32: Tuple[str, ...] = KEEP_F32_PARAMS,
+) -> PyTree:
+    """Downcast the param tree to the compute dtype *while still sharded*.
+
+    The point: flax's `promote_dtype` casts params at the use site — *after*
+    GSPMD has gathered them — so every FSDP all-gather moves f32 bytes even in
+    a bf16 run. Casting each shard first commutes with the gather (a gather
+    rearranges bits, a cast maps them elementwise), so applying the model with
+    the pre-cast tree is bitwise-identical to gather-then-cast while every
+    param collective (ZeRO-3 per-block gathers, the ZeRO-2 step-top gather,
+    pipeline in-body gathers) moves half the bytes.
+
+    Each cast leaf is a `custom_vjp` convert:
+
+    - forward: `astype(dtype)` + re-anchor to the leaf's own NamedSharding (the
+      cast must not perturb GSPMD's placement of the downstream gather);
+    - backward: upcast the cotangent to f32 and pin it to the shard layout —
+      with `grad_reduce_dtype=float32` the convert runs *before* the sharded
+      anchor, so the grad reduce-scatter / all-reduce happens on f32 bits
+      (exact current numerics); with bfloat16 the anchor is applied to the
+      bf16 cotangent first, pinning the reduction on bf16 bits (2x fewer grad
+      bytes, an opt-in precision trade).
+
+    Leaves consumed at f32 by the model (`keep_f32`: head, router) and non-f32
+    leaves pass through untouched. `shardings` must mirror `params`
+    (leaf-for-leaf NamedShardings) or be None (no re-anchor; single-device).
+    """
+    cdtype = jnp.dtype(dtype)
+    reduce_bf16 = jnp.dtype(grad_reduce_dtype) == jnp.bfloat16
+
+    def leaf_fn(path, x, sh):
+        names = _path_names(path)
+        if x.dtype != jnp.float32 or any(k in names for k in keep_f32):
+            return x
+
+        def _fwd_impl(v):
+            y = v.astype(cdtype)
+            if sh is not None:
+                y = jax.lax.with_sharding_constraint(y, sh)
+            return y
+
+        @jax.custom_vjp
+        def cast(v):
+            return _fwd_impl(v)
+
+        def fwd(v):
+            return _fwd_impl(v), None
+
+        def bwd(_, g):
+            if reduce_bf16 and sh is not None:
+                g = jax.lax.with_sharding_constraint(g, sh)
+            g = g.astype(jnp.float32)
+            if not reduce_bf16 and sh is not None:
+                g = jax.lax.with_sharding_constraint(g, sh)
+            return (g,)
+
+        cast.defvjp(fwd, bwd)
+        return cast(x)
+
+    if shardings is None:
+        return jax.tree_util.tree_map_with_path(
+            lambda p, x: leaf_fn(p, x, None), params)
+    return jax.tree_util.tree_map_with_path(leaf_fn, params, shardings)
+
+
+class CommPrecision:
+    """Resolved comm-precision policy for one (cfg, mesh, param-spec) triple.
+
+    Built by `make_comm_precision` only when the policy is active
+    (cfg.comm_cast_active); callers hold `Optional[CommPrecision]` and treat
+    None as "f32 collectives, pre-PR program".
+
+    `cast` downcasts the tree (see `cast_to_compute`); apply it *inside* the
+    differentiated function where possible so the convert-vjp upcasts and pins
+    the cotangent at the cast boundary. `finalize_grads` is the explicit
+    equivalent for paths that cast outside autodiff (ZeRO-2's step-top gather,
+    the 1f1b hand-assembled backward): it upcasts any bf16 grad leaf to f32,
+    pinning the reduction dtype the same way. It is a no-op on f32 leaves, so
+    applying it unconditionally after any grad path is safe.
+    """
+
+    def __init__(self, cfg: Config, mesh: Mesh, params_specs: PyTree):
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.reduce_bf16 = cfg.grad_reduce_dtype == "bfloat16"
+        self.grad_reduce_dtype = (
+            jnp.bfloat16 if self.reduce_bf16 else jnp.float32)
+        self.shardings = shardings_of(mesh, params_specs)
+
+    def cast(self, params: PyTree) -> PyTree:
+        return cast_to_compute(
+            params, self.dtype, self.shardings, self.grad_reduce_dtype)
+
+    def finalize_grads(self, grads: PyTree) -> PyTree:
+        def leaf(g, sh):
+            if g.dtype != self.dtype:
+                return g
+            if self.reduce_bf16:
+                g = jax.lax.with_sharding_constraint(g, sh)
+            return g.astype(jnp.float32)
+        return jax.tree.map(leaf, grads, self.shardings)
+
+
+def make_comm_precision(
+    cfg: Config, mesh: Mesh, params_specs: PyTree,
+) -> Optional[CommPrecision]:
+    """CommPrecision when the bf16 comm-cast policy is active, else None."""
+    if not cfg.comm_cast_active:
+        return None
+    return CommPrecision(cfg, mesh, params_specs)
 
 
 def jit_init_sharded(

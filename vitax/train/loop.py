@@ -10,6 +10,7 @@ step and fetch them when logging.
 
 from __future__ import annotations
 
+import math
 import pprint
 import time
 from typing import Optional
@@ -41,7 +42,9 @@ def _sharded_param_count(state: TrainState) -> int:
     total = 0
     for leaf in jax.tree.leaves(state.params):
         shard = leaf.addressable_shards[0]
-        total += int(jnp.prod(jnp.array(shard.data.shape)))
+        # host-side: shapes are static python tuples; jnp.prod here would
+        # dispatch (and sync on) one tiny device program per parameter leaf
+        total += math.prod(shard.data.shape)
     return total
 
 

@@ -19,7 +19,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from vitax.config import Config
 from vitax.parallel.mesh import BATCH_AXES, Mesh, batch_pspec
-from vitax.parallel.sharding import gather_over_fsdp, shardings_of
+from vitax.parallel.sharding import (
+    gather_over_fsdp, make_comm_precision, shardings_of)
 from vitax.train.state import TrainState
 
 PyTree = Any
@@ -153,17 +154,32 @@ def make_train_step(
       (scan-invariant) and is reused by all K microbatches. K == 1 traces
       the exact pre-accumulation program (no scan wrapper, no extra rng
       fold) — the compiled step is unchanged.
+    - Comm precision (`--param_gather_dtype` / `--grad_reduce_dtype`,
+      vitax/parallel/sharding.py cast_to_compute): when active, the f32
+      master tree is downcast to bf16 while still sharded, so every FSDP
+      param collective moves bf16 bytes. The cast sits INSIDE autodiff for
+      the value_and_grad paths (its convert-vjp upcasts cotangents to f32
+      and pins the grad-reduction dtype); the ZeRO-2 step-top gather and the
+      1f1b hand-assembled backward cast outside autodiff and upcast grads
+      explicitly via `finalize_grads`. With the policy off (or
+      --param_gather_dtype float32) the traced program is bit-for-bit the
+      pre-policy one.
     """
     state_shardings = shardings_of(mesh, state_specs)
     batch_sharding = NamedSharding(mesh, batch_pspec())
     rng_sharding = NamedSharding(mesh, P())
     dropout = _needs_dropout(cfg)
     forward = _forward_fn(cfg, model, mesh, state_specs)
+    comm = make_comm_precision(cfg, mesh, state_specs.params)
 
     moe = cfg.moe_experts > 0
     anchor_logits = _make_logits_anchor(mesh)
 
     def loss_fn(params, batch, rng):
+        if comm is not None:
+            # idempotent: leaves the ZeRO-2 path pre-cast (already bf16)
+            # untouched; elsewhere the convert-vjp rides the backward
+            params = comm.cast(params)
         images = prepare_images(batch["image"])
         det = not dropout
         r = rng if dropout else None
@@ -225,6 +241,11 @@ def make_train_step(
             mb, k = xs
             loss_k, g_k = jax.value_and_grad(loss_fn)(
                 params, mb, jax.random.fold_in(step_rng, k))
+            if comm is not None:
+                # ZeRO-2 pre-cast params yield bf16 microbatch grads: pin
+                # the per-microbatch reduction dtype and upcast before the
+                # f32 accumulation (no-op on the already-f32 grad paths)
+                g_k = comm.finalize_grads(g_k)
             gsum = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                                 gsum, g_k)
             if mesh.size > 1:
@@ -249,7 +270,17 @@ def make_train_step(
         stacked outputs; the objective combines their means AFTER the scan
         — identical to K=1 up to fp reassociation. jax.checkpoint on the
         body keeps residuals at one microbatch (the backward recomputes
-        each microbatch's forward — ~+1F vs the dense manual path)."""
+        each microbatch's forward — ~+1F vs the dense manual path).
+
+        Comm-precision caveat: the cast happens once outside the scan, so
+        the scan's cross-microbatch cotangent accumulation for the (scan-
+        invariant) params runs in bf16 under the bf16 policy — the one path
+        that trades accumulation precision for the comm win. Use
+        --param_gather_dtype float32 with MoE + grad accumulation if exact
+        f32 accumulation matters more than gather bytes."""
+        if comm is not None:
+            params = comm.cast(params)
+
         def mb_terms(p, mb, k):
             images = prepare_images(mb["image"])
             r = jax.random.fold_in(step_rng, k) if dropout else None
@@ -282,7 +313,15 @@ def make_train_step(
     def train_step(state: TrainState, batch, rng):
         step_rng = jax.random.fold_in(rng, state.step)
         if zero2:
-            params = jax.lax.with_sharding_constraint(state.params, gathered_shardings)
+            # cast the SHARDS, then gather: the step-top all-gather (once per
+            # step, reused by backward and all grad-accum microbatches) moves
+            # bf16 bytes and the gathered tree holds half the live memory
+            params = state.params if comm is None else comm.cast(state.params)
+            params = jax.lax.with_sharding_constraint(params, gathered_shardings)
+        elif use_1f1b and comm is not None:
+            # the 1f1b schedule hand-assembles grads (no value_and_grad), so
+            # the cast sits outside autodiff; finalize_grads upcasts below
+            params = comm.cast(state.params)
         else:
             params = state.params
         if use_1f1b:
@@ -292,6 +331,8 @@ def make_train_step(
             loss, grads = accum_value_and_grad(params, batch, step_rng)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, batch, step_rng)
+        if comm is not None:
+            grads = comm.finalize_grads(grads)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -321,11 +362,13 @@ def make_eval_step(cfg: Config, model, mesh: Mesh, state_specs: PyTree):
     state_shardings = shardings_of(mesh, state_specs)
     batch_sharding = NamedSharding(mesh, batch_pspec())
     forward = _forward_fn(cfg, model, mesh, state_specs)
+    comm = make_comm_precision(cfg, mesh, state_specs.params)
 
     anchor_logits = _make_logits_anchor(mesh)
 
     def eval_step(state: TrainState, batch):
-        logits = forward(state.params, prepare_images(batch["image"]), True)
+        params = state.params if comm is None else comm.cast(state.params)
+        logits = forward(params, prepare_images(batch["image"]), True)
         # same batch-sharded logits anchor as the train loss (the argmax
         # iota is the eval-side victim of the mixed layout)
         pred = jnp.argmax(anchor_logits(logits), axis=-1)
