@@ -801,6 +801,8 @@ def bench_train(args, metric_stub: str) -> None:
         kw["param_gather_dtype"] = args.param_gather_dtype
     if args.grad_reduce_dtype != "float32":
         kw["grad_reduce_dtype"] = args.grad_reduce_dtype
+    if args.gather_overlap != "auto":
+        kw["gather_overlap"] = args.gather_overlap
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
@@ -811,7 +813,8 @@ def bench_train(args, metric_stub: str) -> None:
                         or args.att_dropout is not None
                         or args.grad_accum_steps > 1
                         or args.param_gather_dtype is not None
-                        or args.grad_reduce_dtype != "float32"))
+                        or args.grad_reduce_dtype != "float32"
+                        or args.gather_overlap != "auto"))
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll, remat_window=args.remat_window,
@@ -859,7 +862,7 @@ def bench_train(args, metric_stub: str) -> None:
     knobs = ("batch_size", "remat_policy", "scan_blocks", "scan_unroll",
              "remat_window", "grad_ckpt", "use_flash_attention",
              "moe_impl", "att_dropout", "grad_accum_steps",
-             "param_gather_dtype", "grad_reduce_dtype")
+             "param_gather_dtype", "grad_reduce_dtype", "gather_overlap")
     # compare only like-for-like: a knob change (e.g. the scan->unrolled
     # default flip) must not masquerade as a same-config speedup. Entries
     # written before a knob existed compare at the Config FIELD DEFAULT —
@@ -895,6 +898,7 @@ def bench_train(args, metric_stub: str) -> None:
             "grad_accum_steps": cfg.grad_accum_steps,
             "param_gather_dtype": cfg.param_gather_dtype,
             "grad_reduce_dtype": cfg.grad_reduce_dtype,
+            "gather_overlap": cfg.gather_overlap,
         })
 
     # optional collective audit: same report as `tools/comm_audit.py --json`,
@@ -915,6 +919,7 @@ def bench_train(args, metric_stub: str) -> None:
                 "collective_bytes": {
                     op: t["bytes"] for op, t in rep["totals"].items()},
                 "f32_block_param_gathers": len(rep["f32_block_param_gathers"]),
+                "overlap": rep["overlap"],
             }
         except Exception as e:  # audit must never sink a measured number
             comm = {"error": f"{type(e).__name__}: {e}"}
@@ -938,7 +943,8 @@ def bench_train(args, metric_stub: str) -> None:
                   "remat_window": cfg.remat_window,
                   "grad_accum_steps": cfg.grad_accum_steps,
                   "param_gather_dtype": cfg.resolved_param_gather_dtype,
-                  "grad_reduce_dtype": cfg.grad_reduce_dtype},
+                  "grad_reduce_dtype": cfg.grad_reduce_dtype,
+                  "gather_overlap": cfg.gather_overlap},
         **({"comm": comm} if comm is not None else {}),
     })
 
@@ -995,6 +1001,13 @@ def main():
                    help="comm-precision A/B arm: dtype the grad "
                         "reduce-scatter/all-reduce moves (float32 = exact "
                         "pre-policy numerics)")
+    p.add_argument("--gather_overlap", default="auto",
+                   choices=["auto", "off", "on"],
+                   help="overlap A/B arm: double-buffered ZeRO-3 block-param "
+                        "gathers prefetched through the layer-scan carry "
+                        "(off = exact pre-overlap schedule; auto = on "
+                        "whenever ZeRO-3 + scanned blocks + per-block remat "
+                        "are active)")
     p.add_argument("--comm_audit", action="store_true",
                    help="embed the tools/comm_audit.py collective report "
                         "(op/dtype/bytes per step) in the BENCH payload; "

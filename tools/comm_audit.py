@@ -97,6 +97,126 @@ def summarize(rows):
     return totals
 
 
+# ops a value may pass through on its way to the while body's ROOT tuple and
+# still count as "sitting on the carry": layout/dtype plumbing, not compute.
+# A gather whose result reaches ROOT only through these feeds the next
+# iteration's prefetch slot; a gather consumed by a dot/fusion first is a
+# use-site gather.
+_TRIVIAL_OPS = frozenset({
+    "copy", "convert", "bitcast", "bitcast-convert", "reshape", "transpose",
+    "get-tuple-element", "tuple", "optimization-barrier", "all-gather-done",
+})
+
+# `  ROOT name = type op(a, b), attrs...` — name, op, operand list of one
+# instruction line. Handles both dump styles: the verbose one (`%name = f32[2]
+# add(%a, %b)`) and the terse one XLA emits for pass dumps (`add.3 = f32[2]
+# add(p.1, p.2)`); the type may itself be a parenthesised tuple, so the op is
+# "the first bare word directly followed by ( after the =".
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*.*?\s([\w\-]+)\((.*)$")
+_OPERAND_RE = re.compile(r"%?([\w.\-]+)")
+
+
+def _split_computations(hlo_text):
+    """Split an HLO module dump into {computation_name: [instruction lines]}.
+
+    Computation headers sit at column 0 and end with `{`: terse style is
+    `region_0.574_spmd {` / `ENTRY main.1234_spmd {`, verbose style is
+    `%fused (p: f32[2]) -> f32[2] {`. Instruction lines are indented and
+    contain `=`, which the header pattern excludes."""
+    comps = {}
+    name, lines = None, []
+    header = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\b[^=]*{\s*$")
+    for line in hlo_text.splitlines():
+        if name is None:
+            m = header.match(line)
+            if m:
+                name, lines = m.group(1), []
+        elif line.startswith("}"):
+            comps[name] = lines
+            name = None
+        else:
+            lines.append(line)
+    return comps
+
+
+def overlap_verdict(hlo_text):
+    """Structural check of the --gather_overlap schedule.
+
+    Locates every while-loop body in the partitioned module and, per body,
+    counts its all-gathers and how many of them sit ON THE PREFETCH SLOT:
+    their result reaches the body's ROOT tuple (the carry for the next
+    iteration) through nothing but layout/dtype plumbing (_TRIVIAL_OPS).
+    Use-site gathers — what the plain ZeRO-3 scan has — are consumed by a
+    convolution/dot/fusion before any carry, so they never qualify.
+
+    Returns {gathers_in_scan_body, prefetch_slot_gathers,
+    per_iteration_gather_count: {body: count}} — the `--json` overlap
+    verdict the tier-1 suite asserts on (gather count unchanged between
+    off and on; prefetch-slot gathers appear only under on)."""
+    comps = _split_computations(hlo_text)
+    # first-occurrence order = program order of the while ops: the forward
+    # scan's body comes before the backward's, so consumers can key on the
+    # first entry for the fwd-schedule invariants
+    bodies = list(dict.fromkeys(re.findall(r"body=%?([\w.\-]+)", hlo_text)))
+
+    per_body = {}
+    slot_by_body = {}
+    for body in bodies:
+        lines = comps.get(body)
+        if lines is None:
+            continue
+        instrs = {}   # name -> (op, [operand names])
+        root = None
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if not m:
+                continue
+            iname, op, rest = m.groups()
+            # operand names: %refs up to the closing paren of the operand
+            # list (metadata/attrs after it may hold %refs to computations)
+            depth, end = 1, len(rest)
+            for i, ch in enumerate(rest):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        end = i
+                        break
+            instrs[iname] = (op, _OPERAND_RE.findall(rest[:end]))
+            if line.lstrip().startswith("ROOT"):
+                root = iname
+        gathers = {n for n, (op, _) in instrs.items()
+                   if op in ("all-gather", "all-gather-start")}
+        per_body[body] = len(gathers)
+        slot_by_body[body] = 0
+        if root is None or not gathers:
+            continue
+        on_slot = set()
+        seen = set()
+        frontier = [root]
+        while frontier:
+            n = frontier.pop()
+            if n in seen or n not in instrs:
+                continue
+            seen.add(n)
+            op, operands = instrs[n]
+            if op in ("all-gather", "all-gather-start"):
+                on_slot.add(n)
+                continue  # the gather IS the slot value; don't look past it
+            if n == root or op in _TRIVIAL_OPS:
+                frontier.extend(operands)
+        slot_by_body[body] = len(on_slot)
+
+    return {
+        "gathers_in_scan_body": sum(per_body.values()),
+        "prefetch_slot_gathers": sum(slot_by_body.values()),
+        "per_iteration_gather_count": per_body,
+        "prefetch_slot_by_body": slot_by_body,
+    }
+
+
 def gather_bytes(rows, dtype=None, min_numel=0):
     """Total all-gather bytes, optionally filtered by dtype / operand size."""
     return sum(r["bytes"] for r in rows
@@ -162,7 +282,8 @@ def partitioned_hlo_text(cfg, max_iteration=10_000):
 def audit_config(cfg):
     """Full audit report for one config: collective rows + per-op totals +
     the block-param gather facts the tier-1 test asserts on."""
-    rows = collect_collectives(partitioned_hlo_text(cfg))
+    hlo_text = partitioned_hlo_text(cfg)
+    rows = collect_collectives(hlo_text)
     block_numel = cfg.embed_dim * cfg.embed_dim  # smallest block matmul param
     return {
         "config": {
@@ -173,6 +294,7 @@ def audit_config(cfg):
             "run_without_fsdp": cfg.run_without_fsdp,
             "grad_accum_steps": cfg.grad_accum_steps,
             "pp_size": cfg.pp_size,
+            "gather_overlap": cfg.gather_overlap,
         },
         "collectives": rows,
         "totals": summarize(rows),
@@ -181,6 +303,7 @@ def audit_config(cfg):
             r for r in rows
             if r["op"] == "all-gather" and r["dtype"] == "f32"
             and r["numel"] >= block_numel],
+        "overlap": overlap_verdict(hlo_text),
     }
 
 
@@ -202,6 +325,12 @@ def format_report(report):
     bad = report["f32_block_param_gathers"]
     lines.append(f"  f32 block-param all-gathers: "
                  f"{len(bad)}{' <- POLICY NOT APPLIED' if bad else ''}")
+    ov = report.get("overlap")
+    if ov is not None:
+        lines.append(
+            f"  overlap ({c.get('gather_overlap', '?')}): "
+            f"{ov['gathers_in_scan_body']} gathers in scan bodies, "
+            f"{ov['prefetch_slot_gathers']} on the prefetch slot")
     return "\n".join(lines)
 
 

@@ -40,6 +40,11 @@ def main():
     p.add_argument("--grad_reduce_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="comm-precision A/B: dtype the grad reduction moves")
+    p.add_argument("--gather_overlap", default="auto",
+                   choices=["auto", "off", "on"],
+                   help="overlap A/B: prefetch next block-group's ZeRO-3 "
+                        "gathers through the scan carry (off = use-site "
+                        "gathers, the pre-overlap schedule)")
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -76,6 +81,8 @@ def main():
         kw["param_gather_dtype"] = args.param_gather_dtype
     if args.grad_reduce_dtype != "float32":
         kw["grad_reduce_dtype"] = args.grad_reduce_dtype
+    if args.gather_overlap != "auto":
+        kw["gather_overlap"] = args.gather_overlap
     cfg = Config(num_classes=1000, warmup_steps=0,
                  remat_policy=args.remat_policy,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,

@@ -25,6 +25,7 @@ class Config:
     data_dir: str = "/datasets/imagenet-1k"
     fake_data: bool = False
     num_workers: int = 4
+    prefetch_batches: int = 2           # host-prefetch depth of ShardedLoader (queued decoded batches)
     ckpt_dir: str = "/tmp/vit_fsdp"
     resume_epoch: int = 0               # N = resume from epoch N; -1 = auto-resume latest checkpoint
     ckpt_epoch_interval: int = 10
@@ -81,6 +82,17 @@ class Config:
     #   on bf16 bits for another 2x on grad comm (opt-in precision trade).
     param_gather_dtype: Optional[str] = None  # None -> follow --dtype
     grad_reduce_dtype: str = "float32"
+    # Gather/compute overlap (vitax/models/vit.py make_overlap_forward):
+    #   an explicit double-buffered gather schedule for the ZeRO-3 block scan.
+    #   The scan carry holds the already-gathered params for block k while the
+    #   body issues the all-gather (over "fsdp") for block k+1, so the
+    #   collective overlaps block k's matmuls instead of serializing in front
+    #   of them (XLA's latency-hiding scheduler cannot hoist a gather across a
+    #   lax.scan iteration boundary). auto = enable when ZeRO-3 + scanned
+    #   blocks + per-block remat (none_saveable) are active; off = the exact
+    #   pre-overlap program; on = require it (validate() rejects configs the
+    #   schedule cannot serve: pp, ZeRO-2/DP, unscanned blocks, no-remat).
+    gather_overlap: str = "auto"        # auto | off | on
     use_flash_attention: bool = True    # Pallas flash-attention kernel on TPU (jnp fallback elsewhere)
     # Mesh: (dp, fsdp, tp, sp). -1 on fsdp means "all remaining devices".
     dp_size: int = 1
@@ -153,8 +165,36 @@ class Config:
                 f"--{name} must be in [0, 1), got {rate}: rate >= 1 would "
                 f"zero every activation and the kernels' 1/(1-rate) rescale "
                 f"turns that into inf/NaN rather than torch's all-zeros")
+        assert self.prefetch_batches >= 1, (
+            f"--prefetch_batches must be >= 1, got {self.prefetch_batches}: "
+            f"the loader needs at least one queued batch to hand the consumer")
         assert self.grad_accum_steps >= 1, (
             f"--grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
+        assert self.gather_overlap in ("auto", "off", "on"), (
+            f"unknown gather_overlap {self.gather_overlap!r} "
+            f"(expected 'auto', 'off' or 'on')")
+        if self.gather_overlap == "on":
+            assert self.pp_size == 1, (
+                "--gather_overlap on with --pp_size > 1 is rejected: the "
+                "pipeline schedules own their gathers (just-in-time in-body "
+                "gathers pinned per stage, vitax/parallel/pipeline.py) and a "
+                "second prefetch schedule would double-gather every block")
+            assert self.scan_blocks, (
+                "--gather_overlap on needs the scanned stacked block tree "
+                "(drop --no_scan_blocks): the double-buffered prefetch slot "
+                "rides the scan carry")
+            assert self.reshard_after_forward and not self.run_without_fsdp, (
+                "--gather_overlap on needs ZeRO-3 (per-block gathers): under "
+                "ZeRO-2 (--no_reshard_after_forward) the whole tree is "
+                "gathered once at the step top and under --run_without_fsdp "
+                "params are replicated — there is no per-block gather to "
+                "overlap")
+            assert self.grad_ckpt and self.remat_policy == "none_saveable", (
+                "--gather_overlap on requires --grad_ckpt with "
+                "remat_policy=none_saveable: the schedule's backward "
+                "re-gathers each block's shards and recomputes its forward "
+                "(exactly per-block remat); other policies save residuals "
+                "the overlap path would silently discard")
         if self.grad_accum_steps > 1:
             assert self.batch_size % self.grad_accum_steps == 0, (
                 f"--batch_size {self.batch_size} not divisible by "
@@ -288,6 +328,19 @@ def build_parser() -> argparse.ArgumentParser:
     # vitax extensions
     ext = parser.add_argument_group("vitax")
     ext.add_argument("--seed", type=int, default=0)
+    ext.add_argument("--prefetch_batches", type=int, default=2,
+                     help="host-prefetch depth: decoded batches the loader "
+                          "keeps queued ahead of the training loop (>= 1)")
+    ext.add_argument("--gather_overlap", type=str, default="auto",
+                     choices=["auto", "off", "on"],
+                     help="double-buffered ZeRO-3 block-param gathers: the "
+                          "scan body consumes the already-gathered params for "
+                          "block k and issues the all-gather for block k+1, "
+                          "overlapping the collective with block k's compute. "
+                          "auto (default) = enable under zero3 + scanned "
+                          "blocks + none_saveable remat; off = the exact "
+                          "pre-overlap program; on = require it (rejected "
+                          "under pp / ZeRO-2 / DP / --no_scan_blocks).")
     ext.add_argument("--grad_accum_steps", type=int, default=1)
     ext.add_argument("--dtype", type=str, default="bfloat16", choices=["bfloat16", "float32"])
     ext.add_argument("--param_gather_dtype", type=str, default=None,

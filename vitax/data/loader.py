@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterator, Optional, Sequence
 
@@ -146,15 +147,31 @@ class ShardedLoader:
                 yield self._to_device(item)
         finally:
             stop.set()
-            # drain so the producer can exit
-            while t.is_alive():
+            # Drain until the producer thread actually exits: a producer
+            # blocked in q.put never observes `stop` on its own — it needs
+            # the consumer to free a slot first. Breaking on the first empty
+            # read (the old behavior) races exactly that window: the
+            # producer is awake between puts, the queue is momentarily
+            # empty, the drain stops, and the next q.put blocks forever —
+            # leaking the thread (and with it a reference to the dataset)
+            # every time an epoch iterator is abandoned early. Bounded so a
+            # wedged worker can't hang shutdown.
+            deadline = time.monotonic() + 10.0
+            while t.is_alive() and time.monotonic() < deadline:
                 try:
-                    q.get_nowait()
+                    q.get(timeout=0.05)
                 except queue.Empty:
-                    break
+                    pass
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def close(self):
-        self._pool.shutdown(wait=False)
+        # cancel queued decode work, then wait: a shutdown(wait=False) can
+        # drop the pool while __getitem__ calls are mid-flight, and their
+        # exceptions land in dead futures nobody observes
+        try:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+        except TypeError:  # cancel_futures needs python>=3.9
+            self._pool.shutdown(wait=True)
 
 
 def build_datasets(cfg: Config, mesh: Mesh):
@@ -185,6 +202,8 @@ def build_datasets(cfg: Config, mesh: Mesh):
 
     train_sampler = ShardedSampler(len(train_ds), cfg.batch_size, shuffle=True, seed=cfg.seed)
     val_sampler = ShardedSampler(len(val_ds), cfg.batch_size, shuffle=False, seed=cfg.seed)
-    train_loader = ShardedLoader(train_ds, train_sampler, mesh, cfg.num_workers)
-    val_loader = ShardedLoader(val_ds, val_sampler, mesh, cfg.num_workers)
+    train_loader = ShardedLoader(train_ds, train_sampler, mesh, cfg.num_workers,
+                                 prefetch=cfg.prefetch_batches)
+    val_loader = ShardedLoader(val_ds, val_sampler, mesh, cfg.num_workers,
+                               prefetch=cfg.prefetch_batches)
     return train_ds, train_loader, val_ds, val_loader

@@ -530,6 +530,171 @@ def make_windowed_forward(cfg: Config, model: "VisionTransformer"):
     return forward
 
 
+def make_overlap_forward(cfg: Config, model: "VisionTransformer", mesh,
+                         block_specs):
+    """Functional scan forward with an explicit double-buffered gather
+    schedule for the ZeRO-3 block params (--gather_overlap).
+
+    The plain scan leaves each block's fsdp all-gather to GSPMD's use-site
+    insertion, and XLA's latency-hiding scheduler cannot hoist a gather
+    across a lax.scan iteration boundary — so on a pod the gather for block
+    k serializes in front of block k's matmuls. Here the scan carry holds a
+    PREFETCH SLOT: at iteration k the body consumes the already-gathered
+    params for group k (fetched at k-1 via prefetch_gather, which pins the
+    collective on the slot feeding the carry) and issues the gather for
+    group k+1, overlapping it with group k's compute; group 0's gather is
+    issued once before the scan. Groups are --remat_window blocks when the
+    window is active, else single blocks.
+
+    Gradients ride a custom_vjp around the group application, for two
+    reasons measured on this exact structure:
+    - carrying gathered (unsharded) params through a checkpointed scan body
+      makes scan-AD stack them as (L, ...) residuals — the full unsharded
+      model on every device, the ZeRO-3 memory bet inverted;
+    - the ZeRO-3 backward must RE-gather each group's shards (that is what
+      reshard_after_forward means), which plain remat only does as a side
+      effect of recomputing through the use sites.
+    The custom_vjp forward saves only (x, group index, the sharded stacked
+    tree); its backward re-gathers the group explicitly, recomputes the
+    group forward (none_saveable semantics — Config.validate pins the
+    policy), and scatters the group's grads into a zeros-like stacked
+    cotangent. The prefetched carry gets a zero cotangent: grads take the
+    direct stacked-tree route, so the carry chain carries no gradient and
+    AD never materializes a gathered tree it would have to keep.
+
+    Dropout keys and the MoE aux ingredients thread through exactly like
+    make_windowed_forward (same (seed, step) -> same masks; raw frac/prob
+    stacks under with_aux == "raw"). pp is excluded (Config.validate)."""
+    from vitax.parallel.sharding import prefetch_gather
+
+    w = cfg.remat_window if cfg.remat_window > 1 else 1
+    groups = cfg.num_blocks // w
+    block = Block(**model.block_kwargs())  # keeps the activation anchors
+    policy = _REMAT_POLICIES[cfg.remat_policy]
+    dtype = model.dtype
+    moe = cfg.moe_experts > 0
+    has_block_dropout = cfg.att_dropout > 0 or cfg.mlp_dropout > 0
+
+    def forward(params, images, det: bool = True, rng=None,
+                with_aux: bool = False):
+        assert det or rng is not None, "training under dropout needs rng"
+        p = params["params"]
+        x = apply_embed(p, images, patch_size=cfg.patch_size,
+                        embed_dim=cfg.embed_dim, dtype=dtype)
+        if not det and cfg.pos_dropout > 0:
+            pos_rng, rng = jax.random.split(rng)
+            keep = jax.random.bernoulli(pos_rng, 1.0 - cfg.pos_dropout,
+                                        x.shape)
+            x = jnp.where(keep, x / (1.0 - cfg.pos_dropout),
+                          jnp.zeros((), x.dtype))
+        if model.token_sharding is not None:
+            x = jax.lax.with_sharding_constraint(x, model.token_sharding)
+        stacked = p["blocks"]
+        use_keys = not det and has_block_dropout
+        collect_aux = moe and bool(with_aux)
+        # raw uint32 key data (not typed key arrays): the keys cross a
+        # custom_vjp boundary below, and integer leaves there take a None
+        # cotangent cleanly
+        key_data = (jax.random.key_data(
+                        jax.random.split(rng, cfg.num_blocks)
+                    ).reshape(groups, w, -1) if use_keys else None)
+
+        def apply_group(carry, gparams, gkey_data):
+            aux = []
+            for i in range(w):
+                layer = jax.tree.map(lambda g: g[i], gparams)
+                rngs = ({"dropout": jax.random.wrap_key_data(gkey_data[i])}
+                        if use_keys else None)
+                if collect_aux:
+                    carry, cols = block.apply(
+                        {"params": layer}, carry, det, rngs=rngs,
+                        mutable=["intermediates"])
+                    m = cols["intermediates"]["moe"]
+                    aux.append((m["moe_frac_tokens"][0],
+                                m["moe_mean_prob"][0]))
+                else:
+                    carry = block.apply({"params": layer}, carry, det,
+                                        rngs=rngs)
+            if not aux:
+                return carry, ()
+            return carry, (jnp.stack([a[0] for a in aux]),
+                           jnp.stack([a[1] for a in aux]))  # (w, E) each
+
+        @jax.custom_vjp
+        def run_group(x, gathered, g, gkey_data, stacked):
+            del g, stacked  # forward consumes the PREFETCHED params only
+            return apply_group(x, gathered, gkey_data)
+
+        def run_group_fwd(x, gathered, g, gkey_data, stacked):
+            # consumes the PREFETCHED params; `gathered` is deliberately NOT
+            # a residual (a gathered-tree residual would stack to the full
+            # unsharded model across scan iterations — see the docstring)
+            out = apply_group(x, gathered, gkey_data)
+            return out, (x, g, gkey_data, stacked)
+
+        def run_group_bwd(res, ct):
+            x, g, gkey_data, stacked = res
+            with jax.named_scope("blocks_transpose_regather"):
+                # ZeRO-3 backward semantics: re-gather the group's shards
+                regathered = prefetch_gather(stacked, g * w, w, mesh,
+                                             block_specs)
+            # the recompute must run under a remat boundary: jax.checkpoint's
+            # transpose wraps the recomputed values in optimization barriers,
+            # which keeps XLA from fusing the recompute into its consumers and
+            # re-rounding bf16 intermediates differently than the fwd program
+            # did — without it the grads drift one bf16 ulp off the nn.scan
+            # program's (measured; the fwd itself needs no barrier)
+            regroup = jax.checkpoint(
+                lambda x_, gp_: apply_group(x_, gp_, gkey_data),
+                policy=policy, prevent_cse=False)
+            _, vjp = jax.vjp(regroup, x, regathered)
+            dx, dgp = vjp(ct)
+            d_stacked = jax.tree.map(
+                lambda full, d: jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros_like(full), d.astype(full.dtype), g * w,
+                    axis=0),
+                stacked, dgp)
+            # zero cotangent for the prefetched carry: the gradient takes
+            # the direct stacked-tree route, cutting the carry grad chain
+            return (dx, jax.tree.map(jnp.zeros_like, regathered), None,
+                    None, d_stacked)
+
+        run_group.defvjp(run_group_fwd, run_group_bwd)
+
+        def scan_body(carry, xs):
+            x, gathered = carry
+            g = xs[0]
+            gkeys = xs[1] if use_keys else None
+            with jax.named_scope("blocks_overlap"):
+                x, aux = run_group(x, gathered, g, gkeys, stacked)
+            # issue group g+1's gather now, so it overlaps group g+1's wait
+            # with THIS group's compute; the final iteration re-fetches the
+            # last group (in-bounds, result unused)
+            nxt = jnp.minimum(g + 1, groups - 1)
+            with jax.named_scope("blocks_prefetch"):
+                gathered = prefetch_gather(stacked, nxt * w, w, mesh,
+                                           block_specs)
+            return (x, gathered), aux
+
+        with jax.named_scope("prefetch_lead"):
+            gathered0 = prefetch_gather(stacked, 0, w, mesh, block_specs)
+        idx = jnp.arange(groups, dtype=jnp.int32)
+        xs = (idx, key_data) if use_keys else (idx,)
+        (x, _), aux_stacks = jax.lax.scan(
+            scan_body, (x, gathered0), xs,
+            unroll=min(cfg.scan_unroll, groups))
+        logits = apply_tail(p, x, num_classes=cfg.num_classes, dtype=dtype)
+        if not with_aux:
+            return logits
+        fracs, probs = aux_stacks  # (groups, w, E) each
+        if with_aux == "raw":
+            return logits, ((fracs,), (probs,))
+        from vitax.train.step import aux_from_frac_prob
+        return logits, aux_from_frac_prob([fracs], [probs], cfg)
+
+    return forward
+
+
 def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
                 token_sharding=None, moe_dispatch_sharding=None) -> VisionTransformer:
     """Construct the model from config (reference build_fsdp_vit_model parity,

@@ -210,6 +210,73 @@ def gather_over_fsdp(specs: PyTree) -> PyTree:
     return jax.tree.map(strip, specs, is_leaf=lambda x: isinstance(x, P))
 
 
+def gather_overlap_active(cfg: Config, mesh: Mesh) -> bool:
+    """Resolve --gather_overlap {auto,off,on} against the actual mesh.
+
+    `on` is taken at its word (Config.validate already rejected structurally
+    impossible configs; on a mesh without an fsdp axis the prefetch constraints
+    degenerate to no-ops and the schedule is merely pointless, not wrong).
+    `auto` engages only where the schedule both applies and preserves the
+    requested semantics: ZeRO-3 per-block gathers, the scanned stacked tree,
+    per-block remat with none_saveable (the overlap backward re-gathers and
+    recomputes each block — exactly those semantics), no pipeline, and an
+    fsdp axis that actually shards (otherwise there is nothing to overlap)."""
+    mode = getattr(cfg, "gather_overlap", "auto")
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return (cfg.reshard_after_forward
+            and not cfg.run_without_fsdp
+            and cfg.scan_blocks
+            and cfg.grad_ckpt
+            and cfg.remat_policy == "none_saveable"
+            and getattr(cfg, "pp_size", 1) == 1
+            and mesh.shape.get("fsdp", 1) > 1)
+
+
+def prefetch_gather(stacked: PyTree, start, length: int,
+                    mesh: Mesh, block_specs: PyTree) -> PyTree:
+    """Explicitly all-gather `length` layers of the stacked block-param tree
+    over the "fsdp" axis, starting at layer `start` (a traced scalar is fine).
+
+    This is the collective the double-buffered scan schedule issues one
+    iteration ahead of use (--gather_overlap): slicing the stacked (L, ...)
+    leaves first and constraining the slice to the fsdp-stripped layout makes
+    GSPMD emit the gather HERE — on the prefetch slot feeding the scan carry —
+    instead of at the parameter use sites inside the next block's matmuls.
+    Composes with the comm-precision cast (cast_to_compute): the cast runs on
+    the sharded stacked tree before the forward, so under the bf16 policy the
+    prefetched gather moves bf16 bytes (KEEP_F32_PARAMS leaves gather f32,
+    as at the use sites).
+
+    `block_specs` is the PartitionSpec tree of the stacked block params (the
+    `state_specs.params["params"]["blocks"]` subtree); the returned tree holds
+    (length, ...) leaves gathered over fsdp with every other placement (tp,
+    ep) intact."""
+    # specs lead the tree.maps: P is a tuple subclass and must be the
+    # is_leaf-guarded first tree (see vitax/parallel/pipeline.py)
+    is_spec = lambda x: isinstance(x, P)
+    sharded = jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                           block_specs, is_leaf=is_spec)
+    gathered = jax.tree.map(
+        lambda spec: NamedSharding(
+            mesh, P(*[None if ax == "fsdp" else ax for ax in spec])),
+        block_specs, is_leaf=is_spec)
+
+    def leaf(sh_in, sh_out, x):
+        s = jax.lax.dynamic_slice_in_dim(x, start, length, axis=0)
+        # pin the slice to the stacked tree's own (fsdp-sharded) layout
+        # first: without this GSPMD back-propagates the replicated
+        # constraint through the dynamic_slice and hoists the all-gather
+        # ABOVE it — gathering the entire (L, ...) stack every iteration
+        # instead of one group's slice
+        s = jax.lax.with_sharding_constraint(s, sh_in)
+        return jax.lax.with_sharding_constraint(s, sh_out)
+
+    return jax.tree.map(leaf, sharded, gathered, stacked)
+
+
 def cast_to_compute(
     params: PyTree,
     dtype: Any = jnp.bfloat16,

@@ -208,13 +208,23 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 prof["on"] = False
                 master_print(f"profile trace written to {cfg.profile_dir}")
 
-            t_new = time.time()
-            smoothed_time.update(t_new - time_step_b, batch_size=1)
-            time_step_b = t_new
             # first step of THIS RUN (fresh start, epoch-granular resume, or
             # mid-epoch resume alike): always log it — it carries the compile
             is_first_iter = total_steps == 1
-            if is_first_iter or (step + 1) % cfg.log_step_interval == 0:
+            will_log = is_first_iter or (step + 1) % cfg.log_step_interval == 0
+            if will_log:
+                # fence before reading the clock: train_step returns at
+                # dispatch, so an unfenced delta times the async enqueue,
+                # not device execution — the logged sec/iter would converge
+                # to dispatch latency while the devices fall arbitrarily
+                # far behind. The metrics fetch is work _run_logging does
+                # anyway; non-log steps stay fence-free so the pipeline
+                # keeps its device/host overlap.
+                jax.device_get(metrics["loss"])
+            t_new = time.time()
+            smoothed_time.update(t_new - time_step_b, batch_size=1)
+            time_step_b = t_new
+            if will_log:
                 _run_logging(cfg, epoch, step, metrics, schedule, smoothed_loss, smoothed_time)
             if _preempt_agreed(step_in_epoch=step):
                 # commit a synchronous save of the live mid-epoch state under

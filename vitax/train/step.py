@@ -20,7 +20,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from vitax.config import Config
 from vitax.parallel.mesh import BATCH_AXES, Mesh, batch_pspec
 from vitax.parallel.sharding import (
-    gather_over_fsdp, make_comm_precision, shardings_of)
+    gather_over_fsdp, gather_overlap_active, make_comm_precision, shardings_of)
 from vitax.train.state import TrainState
 
 PyTree = Any
@@ -77,6 +77,17 @@ def _forward_fn(cfg: Config, model, mesh: Mesh, state_specs=None):
         if state_specs is not None:
             block_specs = state_specs.params["params"]["blocks"]
         return make_pp_forward(cfg, model, mesh, block_specs=block_specs)
+    if gather_overlap_active(cfg, mesh):
+        # double-buffered ZeRO-3 gather schedule: the scan carry prefetches
+        # the next group's gathered params so the collective overlaps the
+        # current group's compute (subsumes the windowed path — groups are
+        # --remat_window blocks when the window is active, else one block)
+        from vitax.models.vit import make_overlap_forward
+        assert state_specs is not None, (
+            "gather_overlap needs the state spec tree for the stacked "
+            "block-param layout")
+        return make_overlap_forward(
+            cfg, model, mesh, state_specs.params["params"]["blocks"])
     if getattr(cfg, "remat_window", 0) > 1:
         # group-remat functional scan (the wgrad dus-stacking experiment;
         # same param tree, different checkpoint placement)
