@@ -35,22 +35,40 @@ import time
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BASELINE_MEASURED.json")
 
-# bf16 peak TFLOP/s per chip by TPU generation (public figures)
-PEAK_TFLOPS = {
-    "v4": 275.0,
-    "v5 lite": 197.0, "v5e": 197.0,
-    "v5p": 459.0,
-    "v6e": 918.0, "v6 lite": 918.0,
-    "cpu": 1.0,
-}
+# FLOPs/MFU accounting is shared with the training-loop telemetry
+# (vitax/telemetry/flops.py) so bench MFU and the run-log MFU are the same
+# number; the names stay importable from bench (tools/profile_step.py does).
+from vitax.telemetry.flops import (  # noqa: E402
+    PEAK_TFLOPS, detect_peak_tflops, model_flops_per_image)
 
 _emitted = threading.Lock()
+
+# --metrics_dir: also append the emitted payload to <dir>/bench.jsonl
+# (schema-1 telemetry event, kind="bench"). Fail-soft by contract: an
+# unwritable dir warns and never sinks the measured number.
+_metrics_dir = ""
+
+
+def _append_metrics_record(result: dict) -> None:
+    if not _metrics_dir:
+        return
+    try:
+        os.makedirs(_metrics_dir, exist_ok=True)
+        record = dict(result, schema=1, kind="bench", time=time.time())
+        with open(os.path.join(_metrics_dir, "bench.jsonl"), "a",
+                  encoding="utf-8") as f:
+            f.write(json.dumps(record, default=str) + "\n")
+    except OSError as e:
+        print(f"bench: --metrics_dir {_metrics_dir!r} is not writable "
+              f"({e}); continuing without the JSONL record",
+              file=sys.stderr, flush=True)
 
 
 def emit(result: dict) -> None:
     """Print the ONE JSON line, exactly once per process."""
     if _emitted.acquire(blocking=False):
         print(json.dumps(result), flush=True)
+        _append_metrics_record(result)
 
 
 def emit_error(metric: str, error: str, unit: str = "images/sec/chip",
@@ -240,14 +258,6 @@ def init_backend(metric: str, probe_timeout: float, init_patience: float,
         time.sleep(probe_interval)
 
 
-def detect_peak_tflops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for key, val in PEAK_TFLOPS.items():
-        if key in kind:
-            return val
-    return 197.0  # conservative default
-
-
 def train_presets(n_dev: int) -> dict:
     """Benchmark model shapes (shared with tools/profile_step.py so traces
     explain exactly the configs the bench measures)."""
@@ -397,19 +407,6 @@ def default_remat_policy(preset: str, allow_tuned: bool = True) -> str:
         if tuned:
             return tuned
     return "none_saveable" if preset.startswith("10b") else "dots_attn_saveable"
-
-
-def model_flops_per_image(cfg) -> float:
-    """Useful matmul FLOPs per image, fwd+bwd (3x forward)."""
-    d, L = cfg.embed_dim, cfg.num_blocks
-    n = cfg.num_patches
-    h = cfg.mlp_hidden_dim
-    per_token_block = 2 * (3 * d * d + d * d + d * h + h * d)  # qkv, proj, fc1, fc2
-    attn_block = 2 * 2 * n * n * d                             # QK^T and AV
-    fwd = L * (per_token_block * n + attn_block)
-    fwd += 2 * n * (3 * cfg.patch_size ** 2) * d               # patchify conv
-    fwd += 2 * d * cfg.num_classes                             # head
-    return 3.0 * fwd
 
 
 def _write_random_jpegs(dir_path: str, n: int, rng):
@@ -741,6 +738,8 @@ def bench_e2e(args, metric_stub: str) -> None:
     resident_ips = cfg.batch_size * resident_steps / (time.perf_counter() - t0)
 
     overlap_eff = e2e_ips / resident_ips if resident_ips else 0.0
+    peak = detect_peak_tflops(device_kind)
+    e2e_mfu = (e2e_ips * model_flops_per_image(cfg)) / (peak * 1e12 * n_dev)
     base = read_baseline().get("e2e", {})
     same = (base.get("train_preset") == train_preset
             and base.get("host_cpus") == host_cpus
@@ -768,6 +767,8 @@ def bench_e2e(args, metric_stub: str) -> None:
         "value": round(e2e_ips / n_dev, 2),
         "unit": "images/sec/chip",
         "vs_baseline": vs,
+        "mfu": round(e2e_mfu, 4),
+        "peak_tflops_per_chip": peak,
     })
 
 
@@ -931,6 +932,10 @@ def bench_train(args, metric_stub: str) -> None:
         "value": round(images_per_sec_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": vs_baseline,
+        # headline efficiency number, machine-readable (same analytic FLOPs
+        # model as the training-loop telemetry, vitax/telemetry/flops.py)
+        "mfu": round(mfu, 4),
+        "peak_tflops_per_chip": peak,
         # the RESOLVED knob set this number was measured under — ground
         # truth for tools/apply_ladder.py (reconstructing knobs from CLI
         # flags drifts once TUNED.json changes the defaults). Batch is
@@ -1022,6 +1027,11 @@ def main():
                    help="0 = one per CPU core (oversubscription only hurts)")
     p.add_argument("--write_baseline", action="store_true",
                    help="persist measured numbers into BASELINE_MEASURED.json")
+    p.add_argument("--metrics_dir", type=str, default="",
+                   help="also append the emitted payload to "
+                        "<metrics_dir>/bench.jsonl (schema-1 telemetry "
+                        "event); fail-soft: an unwritable dir warns and "
+                        "never sinks the measurement")
     p.add_argument("--probe_timeout", type=float, default=120.0,
                    help="seconds to wait for backend init per probe attempt")
     p.add_argument("--init_patience", type=float, default=900.0,
@@ -1032,6 +1042,9 @@ def main():
                    help="hard deadline: emit an error JSON and exit if the "
                         "bench has not finished by then (0 disables)")
     args = p.parse_args()
+
+    global _metrics_dir
+    _metrics_dir = args.metrics_dir
 
     if args.preset in ("data", "data_scaling"):
         metric_stub = "host data pipeline images/sec (native C++ decode+augment)"

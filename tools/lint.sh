@@ -1,9 +1,20 @@
 #!/usr/bin/env bash
 # Lint gate: flake8 (settings in .flake8, max-line-length 120) over the
-# production tree. tests/test_lint.py runs this as a tier-1 guard when
-# flake8 is installed; CI images without flake8 get a clean skip here too.
+# production tree — vitax/ (including the vitax/telemetry/ observability
+# subsystem), tests/, tools/ (including tools/metrics_report.py) and
+# bench.py. tests/test_lint.py runs this as a tier-1 guard when flake8 is
+# installed; CI images without flake8 get a clean skip here too.
 set -u
 cd "$(dirname "$0")/.."
+
+# the telemetry subsystem and its report tool must exist and stay inside the
+# linted tree (a rename that drops them out of coverage should fail loudly)
+for path in vitax/telemetry tools/metrics_report.py; do
+    if [ ! -e "$path" ]; then
+        echo "lint: expected $path to exist (telemetry lint coverage)" >&2
+        exit 1
+    fi
+done
 
 if ! python -m flake8 --version >/dev/null 2>&1; then
     echo "lint: flake8 not installed; skipping (pip install flake8 to enable)"

@@ -1,0 +1,180 @@
+#!/usr/bin/env python3
+"""Summarize a vitax telemetry JSONL run (vitax/telemetry/, schema 1).
+
+Human mode prints the run at a glance — step range, p50/p95 sec/iter, MFU,
+data-wait fraction, throughput, a loss sparkline, memory peak, watchdog
+events; `--json` emits the same summary as one JSON object for CI.
+
+    python tools/metrics_report.py /runs/exp7/metrics.jsonl
+    python tools/metrics_report.py /runs/exp7/metrics.jsonl --json
+
+Accelerator-free: reads only the JSONL file. Corrupt lines (a run killed
+mid-write can truncate at most the last one) are counted, never fatal.
+Exit status: 0 with >= 1 step record, 2 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Linear-interpolated percentile of an ascending list (numpy-free: the
+    report must run on bare CI hosts)."""
+    if not sorted_vals:
+        return float("nan")
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    pos = (len(sorted_vals) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return float(sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac)
+
+
+def sparkline(vals, width: int = 40) -> str:
+    """Downsampled unicode sparkline (empty string for < 2 points)."""
+    if len(vals) < 2:
+        return ""
+    if len(vals) > width:  # mean-pool into `width` buckets
+        step = len(vals) / width
+        vals = [sum(vals[int(i * step):max(int((i + 1) * step), int(i * step) + 1)])
+                / max(int((i + 1) * step) - int(i * step), 1)
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        SPARK_CHARS[min(int((v - lo) / span * (len(SPARK_CHARS) - 1)),
+                        len(SPARK_CHARS) - 1)]
+        for v in vals)
+
+
+def load_records(path: str):
+    """(step_records, event_records, corrupt_line_count). Step records are
+    sorted by step; anything with a `kind` tag is an event."""
+    steps, events, corrupt = [], [], 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                corrupt += 1
+                continue
+            if not isinstance(rec, dict):
+                corrupt += 1
+            elif rec.get("kind"):
+                events.append(rec)
+            elif "step" in rec and "loss" in rec:
+                steps.append(rec)
+            else:
+                corrupt += 1
+    steps.sort(key=lambda r: r["step"])
+    return steps, events, corrupt
+
+
+def summarize(path: str) -> dict:
+    steps, events, corrupt = load_records(path)
+    summary = {
+        "path": path,
+        "schema": steps[0].get("schema") if steps else None,
+        "records": len(steps),
+        "events": len(events),
+        "corrupt_lines": corrupt,
+        "hang_events": sum(1 for e in events if e.get("kind") == "hang"),
+    }
+    if not steps:
+        return summary
+
+    sec = sorted(r["sec_per_iter"] for r in steps if "sec_per_iter" in r)
+    losses = [r["loss"] for r in steps]
+    mfus = [r["mfu"] for r in steps if "mfu" in r]
+    waits = [r.get("data_wait_s", 0.0) for r in steps]
+    # fraction of each recorded step spent waiting on host data (both sides
+    # are per-step averages over the same record interval)
+    wait_fracs = [r["data_wait_s"] / r["sec_per_iter"] for r in steps
+                  if r.get("sec_per_iter") and "data_wait_s" in r]
+    summary.update({
+        "first_step": steps[0]["step"],
+        "last_step": steps[-1]["step"],
+        "sec_per_iter_p50": round(percentile(sec, 0.50), 6),
+        "sec_per_iter_p95": round(percentile(sec, 0.95), 6),
+        "mfu_last": round(mfus[-1], 6) if mfus else None,
+        "mfu_max": round(max(mfus), 6) if mfus else None,
+        "data_wait_s_mean": round(sum(waits) / len(waits), 6),
+        "data_wait_fraction": (round(sum(wait_fracs) / len(wait_fracs), 6)
+                               if wait_fracs else None),
+        "loss_first": round(losses[0], 6),
+        "loss_last": round(losses[-1], 6),
+        "loss_min": round(min(losses), 6),
+        "images_per_sec_last": round(steps[-1].get("images_per_sec", 0.0), 2),
+        "tokens_per_sec_last": round(steps[-1].get("tokens_per_sec", 0.0), 2),
+        "mem_peak_bytes": max((r.get("mem_peak_bytes",
+                                     r.get("mem_used_bytes", 0))
+                               for r in steps), default=0),
+        "loss_curve": [round(v, 4) for v in losses],
+    })
+    return summary
+
+
+def print_human(summary: dict) -> None:
+    print(f"run: {summary['path']}")
+    print(f"  records: {summary['records']} step + {summary['events']} event"
+          f" ({summary['corrupt_lines']} corrupt lines skipped), "
+          f"schema {summary['schema']}")
+    if summary.get("hang_events"):
+        print(f"  !! watchdog hang events: {summary['hang_events']}")
+    if not summary["records"]:
+        print("  no step records — nothing to summarize")
+        return
+    print(f"  steps {summary['first_step']}..{summary['last_step']}")
+    print(f"  sec/iter: p50 {summary['sec_per_iter_p50']:.4f}  "
+          f"p95 {summary['sec_per_iter_p95']:.4f}")
+    mfu_last = summary["mfu_last"]
+    if mfu_last is not None:
+        print(f"  MFU: last {mfu_last:.4f}  max {summary['mfu_max']:.4f}")
+    if summary["data_wait_fraction"] is not None:
+        starved = " (input-bound!)" if summary["data_wait_fraction"] > 0.3 else ""
+        print(f"  data wait: {summary['data_wait_s_mean']:.4f}s/step, "
+              f"{100 * summary['data_wait_fraction']:.1f}% of step "
+              f"time{starved}")
+    print(f"  throughput: {summary['images_per_sec_last']:.1f} images/s, "
+          f"{summary['tokens_per_sec_last']:.0f} tokens/s (last record)")
+    if summary["mem_peak_bytes"]:
+        print(f"  HBM peak: {summary['mem_peak_bytes'] / 1024 ** 3:.2f} GiB")
+    curve = sparkline(summary["loss_curve"])
+    print(f"  loss: {summary['loss_first']:.4f} -> {summary['loss_last']:.4f}"
+          f" (min {summary['loss_min']:.4f})"
+          + (f"  {curve}" if curve else ""))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="summarize a vitax telemetry JSONL run")
+    p.add_argument("path", help="metrics.jsonl written by --metrics_dir")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as one JSON object (CI mode; the "
+                        "loss_curve field carries the full curve)")
+    args = p.parse_args(argv)
+
+    try:
+        summary = summarize(args.path)
+    except OSError as e:
+        print(f"metrics_report: cannot read {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print_human(summary)
+    return 0 if summary["records"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,6 +127,20 @@ class Config:
     # dots_attn_saveable where activations fit.
     remat_policy: str = "none_saveable" # none_saveable | dots_saveable | dots_attn_saveable (only if grad_ckpt)
     profile_dir: str = ""               # if set, capture a jax.profiler trace of a few steps
+    profile_start_step: int = 2         # global step the profiler window opens after (with --profile_dir)
+    profile_num_steps: int = 5          # steps the profiler window spans (historical default: steps 3-7)
+    # --- vitax: telemetry (vitax/telemetry/; all host-side — the compiled
+    # step program is identical with telemetry on or off) ---
+    metrics_dir: str = ""               # if set, write one JSONL record per log step (schema 1:
+    #   loss, lr, sec/iter, images/s, tokens/s, data-wait, MFU, HBM) under
+    #   <metrics_dir>/metrics.jsonl; summarize with tools/metrics_report.py
+    tensorboard: bool = False           # mirror step records as TB scalars under <metrics_dir>/tb
+    #   (no-op with a warning when the tensorboard package is absent)
+    peak_tflops: float = 0.0            # per-chip peak TFLOP/s for MFU; 0 = detect from the device
+    #   kind (vitax/telemetry/flops.py PEAK_TFLOPS table)
+    hang_timeout_s: float = 0.0         # >0: heartbeat watchdog — dump all-thread stacks + device
+    #   memory (rank-tagged, job left running) after this many seconds
+    #   without a completed step (vitax/telemetry/watchdog.py)
     compile_cache_dir: str = ""         # persistent XLA compile cache (restarts skip recompiles)
     debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
     log_memory: bool = True             # include HBM stats in step log
@@ -270,6 +284,22 @@ class Config:
                 f"--moe_top_k {self.moe_top_k} > --moe_experts "
                 f"{self.moe_experts}: the second choice would be a dead "
                 f"branch with gate ~0")
+        assert self.profile_start_step >= 0, (
+            f"--profile_start_step must be >= 0, got {self.profile_start_step}")
+        assert self.profile_num_steps >= 1, (
+            f"--profile_num_steps must be >= 1, got {self.profile_num_steps}: "
+            f"an empty profiler window would open a trace it never closes "
+            f"in-loop")
+        assert self.peak_tflops >= 0, (
+            f"--peak_tflops must be >= 0 (0 = detect from device kind), "
+            f"got {self.peak_tflops}")
+        assert self.hang_timeout_s >= 0, (
+            f"--hang_timeout_s must be >= 0 (0 = watchdog off), "
+            f"got {self.hang_timeout_s}")
+        if self.tensorboard:
+            assert self.metrics_dir, (
+                "--tensorboard needs --metrics_dir: the TB event files live "
+                "under <metrics_dir>/tb next to the JSONL record they mirror")
         assert self.resolved_param_gather_dtype in ("bfloat16", "float32"), (
             f"unknown param_gather_dtype {self.param_gather_dtype!r}")
         assert self.grad_reduce_dtype in ("bfloat16", "float32"), (
@@ -385,6 +415,32 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--remat_policy", type=str, default=Config.remat_policy,
                      choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
     ext.add_argument("--profile_dir", type=str, default="")
+    ext.add_argument("--profile_start_step", type=int, default=2,
+                     help="global step count after which the jax.profiler "
+                          "trace window opens (with --profile_dir; default 2 "
+                          "skips the compile step)")
+    ext.add_argument("--profile_num_steps", type=int, default=5,
+                     help="how many steps the profiler window spans "
+                          "(default 5 = the historical steps-3..7 window)")
+    ext.add_argument("--metrics_dir", type=str, default="",
+                     help="write one JSONL telemetry record per log step "
+                          "(schema 1: loss, lr, sec/iter, tokens/s, "
+                          "data-wait, MFU, HBM) under "
+                          "<metrics_dir>/metrics.jsonl; summarize with "
+                          "tools/metrics_report.py")
+    ext.add_argument("--tensorboard", action="store_true", dest="tensorboard",
+                     help="mirror telemetry records as TensorBoard scalars "
+                          "under <metrics_dir>/tb (warns and degrades to a "
+                          "no-op when tensorboard is not installed)")
+    ext.add_argument("--peak_tflops", type=float, default=0.0,
+                     help="per-chip peak TFLOP/s for MFU accounting "
+                          "(0 = detect from the device kind via the "
+                          "vitax/telemetry/flops.py table)")
+    ext.add_argument("--hang_timeout_s", type=float, default=0.0,
+                     help=">0: watchdog dumps all-thread Python stacks + "
+                          "device memory stats (rank-tagged, without killing "
+                          "the job) after this many seconds with no "
+                          "completed step")
     ext.add_argument("--compile_cache_dir", type=str, default="")
     ext.add_argument("--debug_nans", action="store_true", dest="debug_nans")
     ext.add_argument("--no_log_memory", action="store_false", dest="log_memory")

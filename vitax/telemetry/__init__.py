@@ -1,0 +1,23 @@
+"""vitax.telemetry — structured observability for training runs.
+
+Subsystem map:
+  flops      analytic model-FLOPs accounting + TPU peak table -> MFU
+  sinks      JSONL event log (always-on) + optional TensorBoard mirror
+  record     Recorder: versioned per-step records fanned out to sinks
+  watchdog   heartbeat hang detector: all-thread stack + memory dumps
+
+Wired through the training stack by vitax/train/loop.py (Recorder lifecycle,
+per-log-step records, watchdog pets), vitax/data/loader.py (host batch-wait
+accounting) and vitax/config.py (--metrics_dir, --tensorboard,
+--peak_tflops, --hang_timeout_s). Everything is host-side: telemetry on or
+off, the compiled step program is identical.
+"""
+
+from vitax.telemetry.flops import (  # noqa: F401
+    PEAK_TFLOPS, detect_peak_tflops, mfu, model_flops_per_image,
+    model_flops_per_step)
+from vitax.telemetry.record import (  # noqa: F401
+    REQUIRED_STEP_KEYS, SCHEMA_VERSION, Recorder, build_recorder)
+from vitax.telemetry.sinks import (  # noqa: F401
+    JsonlSink, TensorBoardSink, make_tensorboard_sink)
+from vitax.telemetry.watchdog import Watchdog, dump_all_stacks  # noqa: F401

@@ -1,0 +1,89 @@
+"""Analytic model-FLOPs accounting -> MFU.
+
+Model FLOPs utilization (MFU, PaLM appendix B convention: Chowdhery et al.
+2022) is useful FLOPs per second divided by the chips' peak FLOPs — the
+headline efficiency number every perf PR is judged against. "Useful" means
+the matmul FLOPs of ONE forward+backward over the batch: remat recompute,
+failed experiments and padding are not useful work, so they are NOT counted
+(true MFU, not hardware FLOPs utilization).
+
+The FLOPs model is closed-form from `Config` — no tracing, no device work:
+patchify conv, per-block qkv/proj + attention einsums + MLP (dense or MoE
+top-k experts + router), classifier head, x3 for fwd+bwd (the standard 6ND
+convention). Grad accumulation and pipeline microbatching reshape WHERE the
+batch's samples flow, not how many matmul FLOPs the optimizer step performs,
+so per-step FLOPs are `per_image x batch_size` for every (K, pp_microbatches)
+setting — the model is accumulation/pipeline aware by construction.
+
+Shared by bench.py, tools/profile_step.py and the training-loop Recorder so
+every MFU the repo reports is the same number.
+"""
+
+from __future__ import annotations
+
+# bf16 peak TFLOP/s per chip by TPU generation (public figures). "cpu" keeps
+# CPU smoke runs' MFU finite and self-consistent rather than meaningless.
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0, "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0, "v6 lite": 918.0,
+    "cpu": 1.0,
+}
+
+DEFAULT_PEAK_TFLOPS = 197.0  # conservative fallback for unknown device kinds
+
+
+def detect_peak_tflops(device_kind: str, override: float = 0.0) -> float:
+    """Per-chip peak TFLOP/s for a PJRT device_kind string; `override` > 0
+    (--peak_tflops) wins unconditionally — the escape hatch for new hardware
+    the table has not met."""
+    if override and override > 0:
+        return float(override)
+    kind = (device_kind or "").lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK_TFLOPS
+
+
+def model_flops_per_image(cfg) -> float:
+    """Useful matmul FLOPs per image, fwd+bwd (3x forward).
+
+    Dense blocks count qkv/proj/fc1/fc2; MoE blocks count the router matmul
+    plus top_k expert MLPs per token (capacity-dropped tokens still occupy
+    their expert slot in the einsum impl, but dropped work is not useful —
+    top_k per token is the honest number). The dense path is term-for-term
+    the historical bench.py accounting, so measured baselines stay
+    comparable."""
+    d, L = cfg.embed_dim, cfg.num_blocks
+    n = cfg.num_patches
+    h = cfg.mlp_hidden_dim
+    attn_per_token = 2 * (3 * d * d + d * d)                   # qkv, proj
+    attn_block = 2 * 2 * n * n * d                             # QK^T and AV
+    if getattr(cfg, "moe_experts", 0) > 0:
+        k = getattr(cfg, "moe_top_k", 1)
+        mlp_per_token = (k * 2 * (d * h + h * d)               # top-k experts
+                         + 2 * d * cfg.moe_experts)            # router logits
+    else:
+        mlp_per_token = 2 * (d * h + h * d)                    # fc1, fc2
+    fwd = L * ((attn_per_token + mlp_per_token) * n + attn_block)
+    fwd += 2 * n * (3 * cfg.patch_size ** 2) * d               # patchify conv
+    fwd += 2 * d * cfg.num_classes                             # head
+    return 3.0 * fwd
+
+
+def model_flops_per_step(cfg) -> float:
+    """Useful FLOPs of one optimizer step = per-image x global batch.
+    Invariant under --grad_accum_steps and --pp_microbatches (see module
+    docstring)."""
+    return model_flops_per_image(cfg) * cfg.batch_size
+
+
+def mfu(cfg, sec_per_iter: float, n_devices: int,
+        peak_tflops_per_chip: float) -> float:
+    """MFU in [0, 1]: achieved useful FLOP/s over aggregate peak FLOP/s."""
+    if sec_per_iter <= 0 or n_devices <= 0 or peak_tflops_per_chip <= 0:
+        return 0.0
+    achieved = model_flops_per_step(cfg) / sec_per_iter
+    return achieved / (peak_tflops_per_chip * 1e12 * n_devices)

@@ -1,0 +1,149 @@
+"""Recorder: structured per-step run records, fanned out to pluggable sinks.
+
+One `record_step` per log step turns the loop's host-side measurements into a
+versioned, machine-readable record (schema 1):
+
+    schema, time, step, epoch, step_in_epoch, loss, lr, grad_norm,
+    sec_per_iter, images_per_sec, tokens_per_sec, data_wait_s, mfu,
+    mem_used_bytes, mem_peak_bytes[, mem_limit_bytes]
+
+MFU comes from the analytic FLOPs model (telemetry/flops.py) over the
+measured sec/iter — no device work, no tracing. `event()` appends
+non-step records (watchdog hang dumps, run metadata) to the same JSONL
+stream, tagged with `kind`.
+
+Everything here is host-side by construction: building a Recorder, or not,
+cannot change the compiled step program or add device->host syncs
+(tests/test_telemetry.py pins that with a lowered-program equality check).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Optional
+
+from vitax.telemetry.flops import (
+    detect_peak_tflops, mfu, model_flops_per_step)
+
+SCHEMA_VERSION = 1
+
+# acceptance contract of a step record: tools/metrics_report.py and the
+# tier-1 round-trip test key off this exact set
+REQUIRED_STEP_KEYS = (
+    "schema", "step", "loss", "sec_per_iter", "data_wait_s", "mfu",
+    "mem_used_bytes",
+)
+
+
+class Recorder:
+    """Fan structured records out to sinks; owns the run's MFU constants."""
+
+    def __init__(self, cfg, sinks, n_devices: int, device_kind: str,
+                 rank: int = 0):
+        self.cfg = cfg
+        self.sinks = list(sinks)
+        self.n_devices = n_devices
+        self.device_kind = device_kind
+        self.rank = rank
+        self.peak_tflops = detect_peak_tflops(
+            device_kind, getattr(cfg, "peak_tflops", 0.0))
+        self.flops_per_step = model_flops_per_step(cfg)
+        self.tokens_per_step = cfg.batch_size * cfg.num_patches
+
+    def _write(self, record: dict) -> None:
+        for sink in self.sinks:
+            try:
+                sink.write(record)
+            except Exception as e:  # noqa: BLE001 — telemetry must not kill training
+                print(f"vitax.telemetry: sink {type(sink).__name__} failed "
+                      f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+
+    def record_step(self, *, step: int, epoch: int, step_in_epoch: int,
+                    loss: float, lr: float, sec_per_iter: float,
+                    data_wait_s: float, grad_norm: Optional[float] = None,
+                    ) -> dict:
+        """One record per log step. `sec_per_iter` / `data_wait_s` are the
+        per-step averages since the previous record; `step` is the global
+        optimizer-step count (monotonically increasing across epochs)."""
+        record = {
+            "schema": SCHEMA_VERSION,
+            "time": time.time(),
+            "step": int(step),
+            "epoch": int(epoch),
+            "step_in_epoch": int(step_in_epoch),
+            "loss": float(loss),
+            "lr": float(lr),
+            "sec_per_iter": float(sec_per_iter),
+            "images_per_sec": (self.cfg.batch_size / sec_per_iter
+                               if sec_per_iter > 0 else 0.0),
+            "tokens_per_sec": (self.tokens_per_step / sec_per_iter
+                               if sec_per_iter > 0 else 0.0),
+            "data_wait_s": float(data_wait_s),
+            "mfu": mfu(self.cfg, sec_per_iter, self.n_devices,
+                       self.peak_tflops),
+        }
+        if grad_norm is not None:
+            record["grad_norm"] = float(grad_norm)
+        record.update(memory_stats_bytes())
+        self._write(record)
+        return record
+
+    def event(self, kind: str, **payload) -> dict:
+        """Non-step record (watchdog dump, run metadata), JSONL-tagged with
+        `kind`; the TensorBoard sink ignores these by design."""
+        record = {"schema": SCHEMA_VERSION, "time": time.time(),
+                  "kind": kind, "rank": self.rank, **payload}
+        self._write(record)
+        return record
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def memory_stats_bytes() -> dict:
+    """Schema-keyed HBM stats (vitax/utils/logging.py memory_stats_dict,
+    renamed to the record's mem_*_bytes fields). mem_used_bytes is always
+    present — 0 when the backend exposes no stats (CPU) — because the record
+    contract promises the key; peak/limit appear only when reported."""
+    from vitax.utils.logging import memory_stats_dict
+    stats = memory_stats_dict()
+    out = {"mem_used_bytes": int(stats.get("bytes_in_use", 0))}
+    if stats.get("peak_bytes_in_use"):
+        out["mem_peak_bytes"] = int(stats["peak_bytes_in_use"])
+    if stats.get("bytes_limit"):
+        out["mem_limit_bytes"] = int(stats["bytes_limit"])
+    return out
+
+
+def build_recorder(cfg, n_devices: int, device_kind: str,
+                   rank: int = 0) -> Optional[Recorder]:
+    """Recorder for this run, or None when telemetry is off.
+
+    None when --metrics_dir is unset, on non-zero ranks (process 0 owns the
+    global step records; the watchdog stays per-rank via stderr), or — fail
+    soft, never crash a run over its observability — when metrics_dir cannot
+    be created or written."""
+    metrics_dir = getattr(cfg, "metrics_dir", "") or ""
+    if not metrics_dir or rank != 0:
+        return None
+    from vitax.telemetry.sinks import JsonlSink
+    try:
+        os.makedirs(metrics_dir, exist_ok=True)
+        sinks = [JsonlSink(os.path.join(metrics_dir, "metrics.jsonl"))]
+    except OSError as e:
+        print(f"vitax.telemetry: --metrics_dir {metrics_dir!r} is not "
+              f"writable ({e}); telemetry disabled for this run",
+              file=sys.stderr, flush=True)
+        return None
+    if getattr(cfg, "tensorboard", False):
+        from vitax.telemetry.sinks import make_tensorboard_sink
+        tb = make_tensorboard_sink(os.path.join(metrics_dir, "tb"))
+        if tb is not None:
+            sinks.append(tb)
+    return Recorder(cfg, sinks, n_devices, device_kind, rank=rank)

@@ -1,0 +1,83 @@
+"""Metric sinks: where structured step records go.
+
+A sink is anything with `write(record: dict)` and `close()`. The Recorder
+fans every record out to all of its sinks:
+
+- `JsonlSink`       — the always-on machine-readable run log: one JSON object
+                      per line, flushed per record so a hang or crash never
+                      loses the committed history (the watchdog's dump must
+                      survive the job it diagnosed).
+- `TensorBoardSink` — optional scalar mirror via tensorboard's no-TF Writer;
+                      built through `make_tensorboard_sink`, which degrades
+                      to None (with one stderr warning) when the package is
+                      absent — telemetry must never add a hard dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import Optional
+
+
+class JsonlSink:
+    """Append-only JSONL event log. Thread-safe: the watchdog thread writes
+    hang events while the train thread writes step records."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def write(self, record: dict) -> None:
+        line = json.dumps(record, sort_keys=True, default=str)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()  # per-record: partial runs must stay readable
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+class TensorBoardSink:
+    """Mirror numeric step-record fields as TB scalars (train/<key>)."""
+
+    # bookkeeping fields that are not scalars worth plotting
+    _SKIP = frozenset({"schema", "step", "time", "kind", "rank"})
+
+    def __init__(self, logdir: str):
+        from tensorboard.summary import Writer  # no-TF writer (TB >= 2.5)
+        self._writer = Writer(logdir)
+
+    def write(self, record: dict) -> None:
+        step = record.get("step")
+        if step is None or record.get("kind"):  # events are JSONL-only
+            return
+        for key, val in record.items():
+            if key in self._SKIP or isinstance(val, bool):
+                continue
+            if isinstance(val, (int, float)):
+                self._writer.add_scalar(f"train/{key}", float(val), int(step))
+        self._writer.flush()
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:  # noqa: BLE001 — close must never raise at exit
+            pass
+
+
+def make_tensorboard_sink(logdir: str) -> Optional[TensorBoardSink]:
+    """TensorBoardSink, or None (one warning) when tensorboard is missing or
+    refuses the logdir — the JSONL sink is the durable record either way."""
+    try:
+        return TensorBoardSink(logdir)
+    except Exception as e:  # noqa: BLE001 — optional dep, degrade to no-op
+        print(f"vitax.telemetry: tensorboard sink disabled "
+              f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+        return None

@@ -1,0 +1,108 @@
+"""Hang watchdog: detect a stalled training loop and dump the evidence.
+
+Large jobs die quietly: a wedged collective, a deadlocked host thread or a
+starved input queue all look like "the log stopped". The watchdog is a
+daemon thread the train loop pets once per step; if `timeout_s` passes
+without a pet it dumps — WITHOUT killing the job —
+
+  - the Python stacks of every live thread (where is the loop actually
+    stuck: `q.get`? a device fetch? a checkpoint write?), and
+  - the live device memory stats (an OOM-thrashing device and a dead
+    interconnect hang differently),
+
+rank-tagged to stderr on every host, plus a structured `kind="hang"` JSONL
+event through the Recorder where one is attached (rank 0). It fires at most
+once per stall: after a dump it stays quiet until the next pet proves the
+loop moved again (MegaScale-style hang detection, Jiang et al. 2024 — the
+job is left alive for the operator or an external supervisor to decide).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+
+def dump_all_stacks() -> str:
+    """Python stacks of every live thread, tagged with thread names."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, "unknown")
+        stack = "".join(traceback.format_stack(frame))
+        parts.append(f"--- thread {name} (ident {ident}) ---\n{stack}")
+    return "\n".join(parts)
+
+
+class Watchdog:
+    """Heartbeat monitor. `start()` it, `pet()` it every step, `stop()` it.
+
+    `on_fire(payload: dict)` runs in the watchdog thread on each dump (the
+    loop wires it to Recorder.event("hang", ...)); `fire_count` counts dumps
+    over the watchdog's lifetime (tests assert it stays 0 on healthy runs).
+    """
+
+    def __init__(self, timeout_s: float,
+                 on_fire: Optional[Callable[[dict], None]] = None,
+                 rank: int = 0, poll_s: Optional[float] = None):
+        assert timeout_s > 0, timeout_s
+        self.timeout_s = float(timeout_s)
+        self.on_fire = on_fire
+        self.rank = rank
+        # poll often enough to notice promptly, rarely enough to cost nothing
+        self.poll_s = poll_s if poll_s else min(max(timeout_s / 4.0, 0.05), 5.0)
+        self.fire_count = 0
+        self._last_pet = time.monotonic()
+        self._fired_since_pet = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        self._last_pet = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="vitax-watchdog")
+        self._thread.start()
+        return self
+
+    def pet(self) -> None:
+        """The loop made progress; re-arm."""
+        self._last_pet = time.monotonic()
+        self._fired_since_pet = False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_s + 1.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            stalled = time.monotonic() - self._last_pet
+            if stalled >= self.timeout_s and not self._fired_since_pet:
+                self._fired_since_pet = True  # once per stall, not per poll
+                self._fire(stalled)
+
+    def _fire(self, stalled_s: float) -> None:
+        self.fire_count += 1
+        from vitax.telemetry.record import memory_stats_bytes
+        try:
+            mem = memory_stats_bytes()
+        except Exception as e:  # noqa: BLE001 — a dead backend must not mute the dump
+            mem = {"error": f"{type(e).__name__}: {e}"}
+        stacks = dump_all_stacks()
+        print(f"[vitax.watchdog rank {self.rank}] no step progress for "
+              f"{stalled_s:.1f}s (timeout {self.timeout_s:.1f}s); dumping "
+              f"all-thread stacks + device memory (job left running)\n"
+              f"{stacks}\n[vitax.watchdog rank {self.rank}] memory: {mem}",
+              file=sys.stderr, flush=True)
+        if self.on_fire is not None:
+            try:
+                self.on_fire({"stalled_s": round(stalled_s, 3),
+                              "timeout_s": self.timeout_s,
+                              "stacks": stacks, **mem})
+            except Exception as e:  # noqa: BLE001
+                print(f"[vitax.watchdog rank {self.rank}] on_fire sink "
+                      f"failed: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)

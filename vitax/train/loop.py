@@ -26,6 +26,7 @@ from vitax.models import build_model, count_params
 from vitax.parallel.mesh import BATCH_AXES, build_mesh
 from vitax.train.state import TrainState, build_optimizer, make_train_state
 from vitax.train.step import make_eval_step, make_train_step
+from vitax.telemetry import Watchdog, build_recorder
 from vitax.utils.logging import master_print, memory_summary
 from vitax.utils.metrics import SmoothedValue
 
@@ -132,6 +133,31 @@ def train(cfg: Config) -> TrainState:
     smoothed_time = SmoothedValue(window_size=5)
     from vitax.train import preempt
     preempt.install()  # SIGTERM -> committed save -> clean exit
+
+    # --- telemetry (vitax/telemetry/): all host-side — the compiled step
+    # program and its dispatch cadence are identical with telemetry off ---
+    recorder = build_recorder(cfg, jax.device_count(),
+                              jax.devices()[0].device_kind,
+                              rank=jax.process_index())
+    if recorder is not None:
+        master_print(f"telemetry: JSONL step records -> {cfg.metrics_dir} "
+                     f"(MFU vs {recorder.peak_tflops:.0f} TF/s/chip peak"
+                     + (", tensorboard mirror on" if cfg.tensorboard else "")
+                     + ")")
+        recorder.event("run_start", device_kind=recorder.device_kind,
+                       n_devices=recorder.n_devices,
+                       peak_tflops=recorder.peak_tflops,
+                       flops_per_step=recorder.flops_per_step,
+                       batch_size=cfg.batch_size)
+    watchdog = None
+    if cfg.hang_timeout_s > 0:
+        on_fire = ((lambda payload: recorder.event("hang", **payload))
+                   if recorder is not None else None)
+        watchdog = Watchdog(cfg.hang_timeout_s, on_fire=on_fire,
+                            rank=jax.process_index()).start()
+        master_print(f"watchdog: stack+memory dump after "
+                     f"{cfg.hang_timeout_s:.0f}s without a completed step")
+
     distributed.barrier("training begins")
     master_print("training begins (the first few iterations are very slow due to compilation)")
 
@@ -140,15 +166,19 @@ def train(cfg: Config) -> TrainState:
         state = _run_epochs(
             cfg, state, train_step, train_loader, val_loader, eval_step,
             schedule, smoothed_loss, smoothed_time, prof,
-            resume_step=resume_step)
+            resume_step=resume_step, recorder=recorder, watchdog=watchdog)
     finally:
         if prof["on"]:
             jax.profiler.stop_trace()
             master_print(f"profile trace written to {cfg.profile_dir}")
+        if watchdog is not None:
+            watchdog.stop()  # before the loaders: their drain must not fire it
         train_loader.close()
         val_loader.close()
         from vitax.checkpoint.orbax_io import wait_until_finished
         wait_until_finished()  # drain any in-flight async save before exit
+        if recorder is not None:
+            recorder.close()
         preempt.uninstall()  # restore normal SIGTERM for post-training work
 
     master_print("training completed")
@@ -175,9 +205,14 @@ def _preempt_agreed(step_in_epoch) -> bool:
 
 def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 schedule, smoothed_loss, smoothed_time, prof,
-                resume_step: int = 0):
+                resume_step: int = 0, recorder=None, watchdog=None):
     data_rng = jax.random.key(cfg.seed + 1)
     total_steps = 0
+    steps_since_record = 0  # averaging window for the per-record data wait
+    # profiler window (historical default: steps 3..7 — start after 2
+    # completed steps so the compile step stays out of the trace)
+    prof_start = cfg.profile_start_step
+    prof_stop = cfg.profile_start_step + cfg.profile_num_steps
     # resume_step > 0: the resume checkpoint was a mid-epoch preemption save —
     # re-enter THAT epoch at the recorded step (the sampler order is a pure
     # function of (seed, epoch), so the data stream continues exactly where
@@ -196,12 +231,18 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 start=start_step):
             if cfg.steps_per_epoch and step >= cfg.steps_per_epoch:
                 break
-            if cfg.profile_dir and total_steps == 2 and not prof["on"]:
+            if cfg.profile_dir and total_steps == prof_start and not prof["on"]:
                 jax.profiler.start_trace(cfg.profile_dir)
                 prof["on"] = True
             state, metrics = train_step(state, batch, data_rng)
             total_steps += 1
-            if prof["on"] and total_steps == 7:
+            steps_since_record += 1
+            if watchdog is not None:
+                # pet on dispatch, not completion: the loop is alive; a wedged
+                # DEVICE stalls the next log step's fence, which stops pets
+                # within log_step_interval dispatches (async dispatch depth)
+                watchdog.pet()
+            if prof["on"] and total_steps == prof_stop:
                 jax.device_get(metrics["loss"])  # fence (block_until_ready is
                 # a no-op on some PJRT transports, e.g. the axon tunnel)
                 jax.profiler.stop_trace()
@@ -212,20 +253,36 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             # mid-epoch resume alike): always log it — it carries the compile
             is_first_iter = total_steps == 1
             will_log = is_first_iter or (step + 1) % cfg.log_step_interval == 0
+            host_loss = None
             if will_log:
                 # fence before reading the clock: train_step returns at
                 # dispatch, so an unfenced delta times the async enqueue,
                 # not device execution — the logged sec/iter would converge
                 # to dispatch latency while the devices fall arbitrarily
-                # far behind. The metrics fetch is work _run_logging does
-                # anyway; non-log steps stay fence-free so the pipeline
-                # keeps its device/host overlap.
-                jax.device_get(metrics["loss"])
+                # far behind. Fetched ONCE here and passed through as a host
+                # value (_run_logging and the telemetry record reuse it);
+                # non-log steps stay fence-free so the pipeline keeps its
+                # device/host overlap.
+                host_loss = float(jax.device_get(metrics["loss"]))
             t_new = time.time()
             smoothed_time.update(t_new - time_step_b, batch_size=1)
             time_step_b = t_new
             if will_log:
-                _run_logging(cfg, epoch, step, metrics, schedule, smoothed_loss, smoothed_time)
+                lr = float(schedule(int(jax.device_get(metrics["lr_step"]))))
+                _run_logging(cfg, epoch, step, host_loss, lr, smoothed_loss,
+                             smoothed_time)
+                if recorder is not None:
+                    # all inputs are already host values; the one extra
+                    # device->host fetch (grad_norm) rides a log step that
+                    # just fenced — non-log steps stay untouched
+                    recorder.record_step(
+                        step=total_steps, epoch=epoch, step_in_epoch=step + 1,
+                        loss=host_loss, lr=lr,
+                        sec_per_iter=smoothed_time.avg,
+                        data_wait_s=(train_loader.consume_wait_s()
+                                     / max(steps_since_record, 1)),
+                        grad_norm=float(jax.device_get(metrics["grad_norm"])))
+                steps_since_record = 0
             if _preempt_agreed(step_in_epoch=step):
                 # commit a synchronous save of the live mid-epoch state under
                 # this epoch's name (with the completed step count in the
@@ -302,14 +359,13 @@ def _select_attention(cfg: Config, mesh):
     return impl
 
 
-def _run_logging(cfg, epoch, step, metrics, schedule, smoothed_loss, smoothed_time):
+def _run_logging(cfg, epoch, step, loss, lr, smoothed_loss, smoothed_time):
     """Throttled step log (reference run_logging, run_vit_training.py:203-213).
 
     The loss is already the global-batch mean — the reference's
-    mesh_reduce(sum)/world_size (:205-206) is compiled into the step. Fetching
-    it here is the only device->host sync, and only on log steps."""
-    loss = float(jax.device_get(metrics["loss"]))
-    lr = float(schedule(int(jax.device_get(metrics["lr_step"]))))
+    mesh_reduce(sum)/world_size (:205-206) is compiled into the step. The
+    caller fetched it (and resolved lr) once at the log-step fence and passes
+    the host values through — no second device->host sync here."""
     smoothed_loss.update(loss, batch_size=1)
     mem = f", {memory_summary()}" if cfg.log_memory else ""
     master_print(

@@ -358,12 +358,29 @@ def make_train_step(
         }
         return new_state, metrics
 
-    return jax.jit(
+    jitted = jax.jit(
         train_step,
         in_shardings=(state_shardings, batch_sharding, rng_sharding),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
     )
+
+    # Work counts for the telemetry throughput records (images/s, tokens/s).
+    # They are static functions of the config, so they are attached HOST-SIDE
+    # after the jitted call: the compiled program gains no outputs, no device
+    # ops, and no device->host syncs (tests/test_telemetry.py pins the
+    # lowered program's equality against the bare step).
+    images_per_step = cfg.batch_size
+    tokens_per_step = cfg.batch_size * cfg.num_patches
+
+    def step_with_counts(state, batch, rng):
+        new_state, metrics = jitted(state, batch, rng)
+        metrics = dict(metrics, images=images_per_step,
+                       tokens=tokens_per_step)
+        return new_state, metrics
+
+    step_with_counts.lower = jitted.lower  # AOT surface (tools/, tests/)
+    return step_with_counts
 
 
 def make_eval_step(cfg: Config, model, mesh: Mesh, state_specs: PyTree):

@@ -1,2 +1,3 @@
 from vitax.utils.metrics import SmoothedValue  # noqa: F401
-from vitax.utils.logging import master_print, memory_summary  # noqa: F401
+from vitax.utils.logging import (  # noqa: F401
+    master_print, memory_stats_dict, memory_summary)

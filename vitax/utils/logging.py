@@ -22,11 +22,13 @@ def master_print(*args, **kwargs) -> None:
         print(*args, **kwargs)
 
 
-def memory_summary(device=None) -> str:
-    """Human-readable HBM usage for the step log (xm.get_memory_info parity).
+def memory_stats_dict(device=None) -> dict:
+    """Raw HBM stats as a dict for machine consumers (the telemetry sinks):
+    {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"} — keys absent when
+    the backend does not report them, {} on CPU where PJRT has no stats.
 
     Uses PJRT memory_stats when the backend provides them (TPU does); degrades
-    gracefully on CPU where stats are unavailable.
+    gracefully where stats are unavailable.
     """
     device = device or jax.local_devices()[0]
     try:
@@ -34,14 +36,29 @@ def memory_summary(device=None) -> str:
     except Exception:
         stats = None
     if not stats:
-        return "mem: n/a"
-    in_use = stats.get("bytes_in_use", 0)
-    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        return {}
+    out = {}
+    if stats.get("bytes_in_use") is not None:
+        out["bytes_in_use"] = int(stats["bytes_in_use"])
     peak = stats.get("peak_bytes_in_use")
-    gib = 1024 ** 3
-    parts = [f"used={in_use / gib:.2f}GiB"]
     if peak:
-        parts.append(f"peak={peak / gib:.2f}GiB")
+        out["peak_bytes_in_use"] = int(peak)
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
     if limit:
-        parts.append(f"limit={limit / gib:.2f}GiB")
+        out["bytes_limit"] = int(limit)
+    return out
+
+
+def memory_summary(device=None) -> str:
+    """Human-readable HBM usage for the step log (xm.get_memory_info parity),
+    rendered from the same memory_stats_dict the telemetry records use."""
+    stats = memory_stats_dict(device)
+    if not stats:
+        return "mem: n/a"
+    gib = 1024 ** 3
+    parts = [f"used={stats.get('bytes_in_use', 0) / gib:.2f}GiB"]
+    if "peak_bytes_in_use" in stats:
+        parts.append(f"peak={stats['peak_bytes_in_use'] / gib:.2f}GiB")
+    if "bytes_limit" in stats:
+        parts.append(f"limit={stats['bytes_limit'] / gib:.2f}GiB")
     return "mem: " + " ".join(parts)

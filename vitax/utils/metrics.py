@@ -54,4 +54,6 @@ class SmoothedValue:
         return self._sum / self._weight if self._weight else float("nan")
 
     def get_latest(self) -> float:
-        return self._window[-1][0]
+        # empty window -> nan, like median/avg/global_avg (an IndexError here
+        # would crash the first log line of a run that has not updated yet)
+        return self._window[-1][0] if self._window else float("nan")
