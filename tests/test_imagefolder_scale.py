@@ -78,10 +78,12 @@ def test_imagefolder_training_learns_at_scale(devices8, tmp_path):
                                     materialize=False)
     eval_step = make_eval_step(cfg, model, mesh, sspecs)
     try:
-        accuracy, n_correct, total = eval_on_val(cfg, val_loader, eval_step, state)
+        accuracy, top5, n_correct, total = eval_on_val(
+            cfg, val_loader, eval_step, state)
     finally:
         val_loader.close()
     assert total == 200  # 10 classes x 20, batch 40 -> 5 full batches
+    assert top5 >= accuracy
     assert accuracy > 0.5, (
         f"val accuracy {accuracy:.2f} barely above chance — the data path "
         f"is delivering label-inconsistent tensors")

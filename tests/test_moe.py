@@ -336,7 +336,7 @@ def test_moe_eval_step(devices8):
         "label": jax.device_put(jnp.asarray(rng.integers(
             0, cfg.num_classes, size=(cfg.batch_size,)), jnp.int32), sh),
     }
-    correct = int(jax.device_get(eval_step(state, batch)))
+    correct = int(jax.device_get(eval_step(state, batch)["correct"]))
 
     logits = model.apply(state.params, batch["image"], True)
     want = int(jnp.sum(jnp.argmax(logits, -1) == batch["label"]))

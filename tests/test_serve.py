@@ -1,0 +1,448 @@
+"""vitax.serve end-to-end on the 8-virtual-device CPU mesh: 2-step fake-data
+train -> checkpoint -> engine load (Orbax + consolidated npz) -> dynamic
+batcher (flush-by-size / flush-by-timeout) -> HTTP predict round-trip on an
+ephemeral port -> zero recompiles after warmup -> serve.jsonl contract ->
+serve_bench summary, plus the consolidate round-trip and serve-flag
+validation satellites.
+"""
+
+import base64
+import io
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from vitax.config import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        image_size=16, patch_size=8, embed_dim=32, num_heads=2, num_blocks=2,
+        num_classes=4, batch_size=16, dtype="float32", lr=1e-3, warmup_steps=2,
+        serve_max_batch=4, serve_topk=3, max_batch_wait_ms=10.0, seed=0,
+    )
+    base.update(kw)
+    return Config(**base).validate()
+
+
+def post_json(url: str, payload: dict, timeout: float = 60.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def post_bytes(url: str, body: bytes, content_type: str = "image/png",
+               timeout: float = 60.0) -> dict:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": content_type})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def get_json(url: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def png_bytes(size: int = 20, seed: int = 0) -> bytes:
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 256, size=(size, size, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr, "RGB").save(buf, "PNG")
+    return buf.getvalue()
+
+
+# --- the served stack: train -> checkpoint -> engine -> HTTP (module-scoped:
+# warmup compiles every bucket once for all tests below) ---
+
+@pytest.fixture(scope="module")
+def served(devices8, tmp_path_factory):
+    from vitax.serve import InferenceEngine, start_server, stop_server
+    from vitax.train.loop import train
+
+    root = tmp_path_factory.mktemp("serve")
+    ckpt_dir = str(root / "ckpt")
+    metrics_dir = str(root / "metrics")
+    cfg = tiny_cfg(
+        fake_data=True, num_epochs=1, steps_per_epoch=2, log_step_interval=1,
+        ckpt_dir=ckpt_dir, ckpt_epoch_interval=1, test_epoch_interval=1,
+        num_workers=2, eval_max_batches=1, metrics_dir=metrics_dir,
+        serve_port=0,
+    )
+    train(cfg)  # 2 real optimizer steps; writes epoch_1
+    assert os.path.isdir(os.path.join(ckpt_dir, "epoch_1"))
+
+    engine = InferenceEngine.from_checkpoint(cfg, ckpt_dir, 1)
+    engine.warmup()
+    httpd, ctx = start_server(cfg, engine, port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield cfg, engine, url, metrics_dir
+    stop_server(httpd, ctx)
+
+
+# --- engine -----------------------------------------------------------------
+
+
+def test_engine_buckets_and_warmup(served):
+    _, engine, _, _ = served
+    assert engine.buckets == (1, 2, 4)
+    # AOT warmup compiled each bucket exactly once
+    assert engine.compile_count == 3
+
+
+def test_engine_predict_shapes_and_padding(served):
+    cfg, engine, _, _ = served
+    for n in (1, 2, 3, 4):
+        ids, probs = engine.predict(
+            np.zeros((n, cfg.image_size, cfg.image_size, 3), np.uint8))
+        assert ids.shape == (n, engine.topk)
+        assert probs.shape == (n, engine.topk)
+        # top-k probs are descending and valid
+        assert np.all(np.diff(probs, axis=1) <= 1e-6)
+        assert np.all((probs >= 0) & (probs <= 1))
+    # identical rows -> identical outputs regardless of bucket padding
+    img = np.full((1, cfg.image_size, cfg.image_size, 3), 7, np.uint8)
+    one = engine.predict(img)
+    three = engine.predict(np.repeat(img, 3, axis=0))
+    np.testing.assert_array_equal(one[0][0], three[0][2])
+    np.testing.assert_allclose(one[1][0], three[1][2], rtol=1e-5)
+
+
+def test_engine_zero_recompiles_after_warmup(served):
+    """Mixed-size bursts execute precompiled buckets only: the compile count
+    is pinned at len(buckets) and an unseen batch size raises instead of
+    silently recompiling."""
+    cfg, engine, _, _ = served
+    before = engine.compile_count
+    for n in (3, 1, 4, 2, 1, 3):
+        engine.predict(
+            np.zeros((n, cfg.image_size, cfg.image_size, 3), np.uint8))
+    assert engine.compile_count == before == len(engine.buckets)
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        engine.predict(
+            np.zeros((5, cfg.image_size, cfg.image_size, 3), np.uint8))
+
+
+def test_engine_npz_round_trip_matches_checkpoint(served, tmp_path):
+    """consolidate -> from_npz restores the exact param tree: same compiled
+    program, same input => identical predictions (the regression test of the
+    shared flatten/unflatten key convention)."""
+    from vitax.checkpoint.consolidate import consolidate
+    from vitax.serve import InferenceEngine
+
+    cfg, engine, _, _ = served
+    out = str(tmp_path / "full.npz")
+    consolidate(cfg.ckpt_dir, 1, out)
+    engine2 = InferenceEngine.from_npz(cfg, out)
+    engine2.warmup()
+    # exact round trip: every leaf bitwise-equal to the served params
+    flat_a = jax.tree.leaves(engine.params)
+    flat_b = jax.tree.leaves(engine2.params)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 256,
+                       size=(3, cfg.image_size, cfg.image_size, 3),
+                       ).astype(np.uint8)
+    ids_a, probs_a = engine.predict(img)
+    ids_b, probs_b = engine2.predict(img)
+    np.testing.assert_array_equal(ids_a, ids_b)
+    np.testing.assert_allclose(probs_a, probs_b, rtol=1e-6)
+
+
+# --- consolidation round-trip (satellite) -----------------------------------
+
+
+def test_flatten_unflatten_round_trip():
+    from vitax.checkpoint.consolidate import flatten_tree, unflatten_tree
+    tree = {"params": {"blocks": {"attn": {"kernel": np.arange(6.0).reshape(2, 3)},
+                                  "bias": np.zeros(3)},
+                       "head": {"kernel": np.ones((3, 4))}}}
+    flat = flatten_tree(tree)
+    assert set(flat) == {"params/blocks/attn/kernel", "params/blocks/bias",
+                         "params/head/kernel"}
+    rebuilt = unflatten_tree(flat)
+    assert jax.tree_util.tree_structure(tree) == \
+        jax.tree_util.tree_structure(rebuilt)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(rebuilt)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("dtype", [None, "float32", "bfloat16"])
+def test_save_npz_dtype_round_trip(tmp_path, dtype):
+    import ml_dtypes
+    from vitax.checkpoint.consolidate import load_npz, save_npz
+    flat = {"a/w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "a/b": np.ones(3, np.float32),
+            "step": np.asarray(7, np.int32)}
+    out = str(tmp_path / f"x_{dtype}.npz")
+    save_npz(out, flat, dtype=dtype)
+    back = load_npz(out)
+    assert set(back) == set(flat)
+    if dtype == "bfloat16":
+        assert back["a/w"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_allclose(
+            back["a/w"].astype(np.float32), flat["a/w"], rtol=1e-2)
+    else:
+        assert back["a/w"].dtype == np.float32
+        np.testing.assert_array_equal(back["a/w"], flat["a/w"])
+    # non-float leaves are never cast
+    assert back["step"].dtype == np.int32 and int(back["step"]) == 7
+
+
+# --- batcher (engine-free: a fake predict_fn pins flush semantics) ----------
+
+
+def _fake_predict(calls, delay_s=0.0):
+    def predict(images):
+        if delay_s:
+            time.sleep(delay_s)
+        calls.append(images.shape[0])
+        n = images.shape[0]
+        return (np.tile(np.arange(3, dtype=np.int32), (n, 1)),
+                np.tile(np.array([0.5, 0.3, 0.2], np.float32), (n, 1)))
+    return predict
+
+
+def test_batcher_flush_by_size():
+    """max_batch simultaneous submissions flush as ONE batch well before the
+    (deliberately huge) deadline."""
+    from vitax.serve import DynamicBatcher
+    calls = []
+    b = DynamicBatcher(_fake_predict(calls), max_batch=4,
+                       max_wait_ms=60_000.0)
+    try:
+        t0 = time.time()
+        futs = [b.submit(np.zeros((4, 4, 3), np.uint8)) for _ in range(4)]
+        results = [f.result(timeout=30) for f in futs]
+        assert time.time() - t0 < 30  # did not wait out the minute deadline
+        assert calls == [4]
+        assert all(r.batch_size == 4 for r in results)
+        assert all(r.classes.shape == (3,) for r in results)
+    finally:
+        b.close()
+
+
+def test_batcher_flush_by_timeout():
+    """A lone request flushes at the deadline, not at bucket-full."""
+    from vitax.serve import DynamicBatcher
+    calls = []
+    b = DynamicBatcher(_fake_predict(calls), max_batch=4, max_wait_ms=50.0)
+    try:
+        t0 = time.time()
+        r = b.submit(np.zeros((4, 4, 3), np.uint8)).result(timeout=30)
+        elapsed = time.time() - t0
+        assert calls == [1]
+        assert r.batch_size == 1
+        assert elapsed >= 0.04  # waited (most of) the deadline for company
+    finally:
+        b.close()
+
+
+def test_batcher_error_propagates_to_futures():
+    from vitax.serve import DynamicBatcher
+
+    def boom(images):
+        raise RuntimeError("engine fell over")
+
+    b = DynamicBatcher(boom, max_batch=2, max_wait_ms=5.0)
+    try:
+        fut = b.submit(np.zeros((4, 4, 3), np.uint8))
+        with pytest.raises(RuntimeError, match="fell over"):
+            fut.result(timeout=30)
+        # the worker survived the exception and still serves
+        assert b.submit is not None and b.queue_depth() == 0
+    finally:
+        b.close()
+
+
+# --- HTTP -------------------------------------------------------------------
+
+
+def test_http_predict_round_trip(served):
+    cfg, engine, url, _ = served
+    # raw image bytes
+    resp = post_bytes(url + "/predict", png_bytes(seed=1))
+    assert len(resp["classes"]) == engine.topk
+    assert len(resp["probs"]) == engine.topk
+    assert all(0 <= c < cfg.num_classes for c in resp["classes"])
+    assert resp["probs"] == sorted(resp["probs"], reverse=True)
+    # base64 JSON with a per-request topk
+    resp2 = post_json(url + "/predict",
+                      {"image": base64.b64encode(png_bytes(seed=2)).decode(),
+                       "topk": 2})
+    assert len(resp2["classes"]) == 2 and len(resp2["probs"]) == 2
+
+
+def test_http_mixed_burst_zero_recompiles(served):
+    """A concurrent burst of requests exercises multiple buckets through the
+    batcher with zero recompiles (the acceptance-criteria check)."""
+    cfg, engine, url, _ = served
+    before = engine.compile_count
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def worker(seed):
+        try:
+            r = post_bytes(url + "/predict", png_bytes(seed=seed))
+            with lock:
+                results.append(r)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert len(results) == 10
+    assert engine.compile_count == before  # zero recompiles under load
+    # the burst actually batched: fewer flushes than requests
+    metrics = get_json(url + "/metrics")
+    assert metrics["requests_total"] >= 10
+    assert metrics["compile_count"] == before
+
+
+def test_http_healthz_and_metrics(served):
+    _, engine, url, _ = served
+    health = get_json(url + "/healthz")
+    assert health["status"] == "ok"
+    assert health["buckets"] == list(engine.buckets)
+    metrics = get_json(url + "/metrics")
+    for key in ("requests_total", "errors_total", "requests_per_sec",
+                "latency_s_p50", "latency_s_p95", "latency_s_p99",
+                "batch_occupancy_mean", "queue_depth"):
+        assert key in metrics, key
+
+
+def test_http_bad_requests(served):
+    _, _, url, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/predict", b"not an image")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/nope", png_bytes())
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_json(url + "/predict",
+                  {"image": base64.b64encode(png_bytes()).decode(),
+                   "topk": 99})
+    assert e.value.code == 400
+
+
+# --- serve.jsonl contract + bench -------------------------------------------
+
+# every serve_request record must carry these (vitax/serve/server.py
+# REQUIRED_SERVE_KEYS + the Recorder envelope)
+ENVELOPE_KEYS = ("schema", "time", "kind")
+
+
+def test_serve_jsonl_contract(served):
+    from vitax.serve import REQUIRED_SERVE_KEYS
+    _, _, url, metrics_dir = served
+    post_bytes(url + "/predict", png_bytes(seed=9))  # at least one record
+    path = os.path.join(metrics_dir, "serve.jsonl")
+    assert os.path.exists(path)
+    records = [json.loads(line) for line in open(path) if line.strip()]
+    kinds = {r["kind"] for r in records}
+    assert "serve_start" in kinds and "serve_request" in kinds
+    reqs = [r for r in records if r["kind"] == "serve_request"]
+    for rec in reqs:
+        for key in ENVELOPE_KEYS + REQUIRED_SERVE_KEYS:
+            assert key in rec, (key, rec)
+        assert rec["schema"] == 1
+        assert rec["batch_size"] <= rec["bucket"]
+        assert rec["queue_wait_s"] <= rec["latency_s"]
+
+
+def test_serve_bench_reports(served):
+    """tools/serve_bench.py --json contract: throughput + p50/p95/p99 from
+    both the client loop and the server's serve.jsonl records."""
+    _, _, url, metrics_dir = served
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import serve_bench
+    finally:
+        sys.path.pop(0)
+    summary = serve_bench.run_bench(
+        url, concurrency=4, requests_per_worker=3, image_size=20,
+        timeout=60.0, serve_jsonl=os.path.join(metrics_dir, "serve.jsonl"))
+    assert summary["completed"] == 12 and summary["errors"] == 0
+    assert summary["throughput_rps"] > 0
+    for key in ("latency_s_p50", "latency_s_p95", "latency_s_p99"):
+        assert summary[key] > 0
+        assert summary["server"][key] > 0
+    assert summary["server"]["records"] >= 12
+    assert 0 < summary["server"]["batch_occupancy_mean"] <= 1.0
+    # --json emits one parseable object
+    json.dumps(summary)
+
+
+# --- eval top-5 + telemetry (satellite) -------------------------------------
+
+
+def test_eval_event_in_metrics_jsonl(served):
+    """The fixture's training run had --metrics_dir + test_epoch_interval=1,
+    so eval_on_val must have emitted a kind:"eval" event (epoch, top1, top5,
+    n) into metrics.jsonl — and metrics_report must surface it."""
+    _, _, _, metrics_dir = served
+    path = os.path.join(metrics_dir, "metrics.jsonl")
+    assert os.path.exists(path)
+    evals = [json.loads(line) for line in open(path)
+             if line.strip() and '"eval"' in line]
+    evals = [r for r in evals if r.get("kind") == "eval"]
+    assert evals, "train() with test_epoch_interval=1 emitted no eval event"
+    ev = evals[-1]
+    assert ev["epoch"] == 1
+    assert 0.0 <= ev["top1"] <= ev["top5"] <= 1.0
+    assert ev["n"] > 0
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_report
+    finally:
+        sys.path.pop(0)
+    summary = metrics_report.summarize(path)
+    assert summary["eval_last"] == {k: ev[k]
+                                    for k in ("epoch", "top1", "top5", "n")}
+
+
+# --- config validation (satellite) ------------------------------------------
+
+
+@pytest.mark.parametrize("kw,match", [
+    (dict(eval_max_batches=-1), "eval_max_batches"),
+    (dict(serve_port=-1), "serve_port"),
+    (dict(serve_port=70000), "serve_port"),
+    (dict(serve_max_batch=0), "serve_max_batch"),
+    (dict(serve_max_batch=3), "power of two"),
+    (dict(max_batch_wait_ms=-1.0), "max_batch_wait_ms"),
+    (dict(serve_topk=0), "serve_topk"),
+    (dict(serve_topk=-3), "serve_topk"),
+])
+def test_config_serve_validation_rejects(kw, match):
+    with pytest.raises(AssertionError, match=match):
+        tiny_cfg(**kw)
+
+
+def test_config_serve_defaults_valid():
+    cfg = Config().validate()
+    assert cfg.serve_port == 8000 and cfg.serve_max_batch == 8
+    assert cfg.serve_topk == 5 and cfg.max_batch_wait_ms == 5.0

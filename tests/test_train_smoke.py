@@ -142,8 +142,14 @@ def test_eval_step_counts_correct(devices8):
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
     eval_fn = make_eval_step(cfg, model, mesh, sspecs)
     batch = random_batch(cfg, mesh)
-    correct = int(jax.device_get(eval_fn(state, batch)))
+    counts = jax.device_get(eval_fn(state, batch))
+    correct = int(counts["correct"])
+    correct5 = int(counts["correct_top5"])
     assert 0 <= correct <= cfg.batch_size
+    # top-5 dominates top-1; with num_classes=4 < 5, k clamps to 4 and
+    # every sample's label is in the top-4 by construction
+    assert correct <= correct5 <= cfg.batch_size
+    assert correct5 == cfg.batch_size
 
 
 def test_full_loop_fake_data(devices8, tmp_path):
@@ -265,7 +271,8 @@ def test_model_actually_learns(devices8):
         state, metrics = step_fn(state, color_batch(i), rng_key)
 
     # held-out batches (seeds never trained on)
-    correct = sum(int(jax.device_get(eval_fn(state, color_batch(1000 + j))))
-                  for j in range(4))
+    correct = sum(
+        int(jax.device_get(eval_fn(state, color_batch(1000 + j))["correct"]))
+        for j in range(4))
     accuracy = correct / (4 * cfg.batch_size)
     assert accuracy > 0.9, f"model failed to learn a separable task: {accuracy=}"

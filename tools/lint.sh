@@ -7,11 +7,12 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# the telemetry subsystem and its report tool must exist and stay inside the
-# linted tree (a rename that drops them out of coverage should fail loudly)
-for path in vitax/telemetry tools/metrics_report.py; do
+# these subsystems and their tools must exist and stay inside the linted
+# tree (a rename that drops them out of coverage should fail loudly)
+for path in vitax/telemetry tools/metrics_report.py \
+            vitax/serve tools/serve_bench.py tests/test_serve.py; do
     if [ ! -e "$path" ]; then
-        echo "lint: expected $path to exist (telemetry lint coverage)" >&2
+        echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
     fi
 done

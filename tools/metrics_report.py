@@ -89,6 +89,11 @@ def summarize(path: str) -> dict:
         "corrupt_lines": corrupt,
         "hang_events": sum(1 for e in events if e.get("kind") == "hang"),
     }
+    evals = [e for e in events if e.get("kind") == "eval"]
+    if evals:
+        last = max(evals, key=lambda e: (e.get("epoch", 0), e.get("time", 0)))
+        summary["eval_last"] = {k: last.get(k)
+                                for k in ("epoch", "top1", "top5", "n")}
     if not steps:
         return summary
 
@@ -130,6 +135,10 @@ def print_human(summary: dict) -> None:
           f"schema {summary['schema']}")
     if summary.get("hang_events"):
         print(f"  !! watchdog hang events: {summary['hang_events']}")
+    ev = summary.get("eval_last")
+    if ev:
+        print(f"  eval (epoch {ev['epoch']}): top1 {ev['top1']:.4f}  "
+              f"top5 {ev['top5']:.4f}  (n={ev['n']})")
     if not summary["records"]:
         print("  no step records — nothing to summarize")
         return

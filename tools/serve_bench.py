@@ -1,0 +1,199 @@
+#!/usr/bin/env python3
+"""Closed-loop load generator for the vitax serving stack (vitax/serve/).
+
+Each worker thread issues POST /predict requests back-to-back (closed loop:
+a worker's next request starts when its previous response lands), so
+`--concurrency` bounds the in-flight requests and the dynamic batcher's
+occupancy. Reports throughput and client-side p50/p95/p99 latency; when the
+server ran with --metrics_dir, point --serve_jsonl at its serve.jsonl to
+fold in the server-side per-request records (queue wait, engine latency,
+batch occupancy) for the same window.
+
+    python tools/serve_bench.py --url http://127.0.0.1:8000 \
+        --concurrency 8 --requests 200 --image_size 224
+    python tools/serve_bench.py ... --serve_jsonl /runs/s/serve.jsonl --json
+
+stdlib-only (urllib + threading): the bench must run on bare CI hosts.
+Exit status: 0 when every request succeeded, 2 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def percentile(sorted_vals, q: float):
+    """Linear-interpolated percentile of an ascending list (shared shape
+    with tools/metrics_report.py percentile — numpy-free)."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    pos = (len(sorted_vals) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return float(sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac)
+
+
+def make_image_bytes(image_size: int, seed: int = 0) -> bytes:
+    """One PNG request body (random noise — serving cost is content-free)."""
+    import numpy as np
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 256, size=(image_size, image_size, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr, "RGB").save(buf, "PNG")
+    return buf.getvalue()
+
+
+def run_worker(url: str, body: bytes, n_requests: int, timeout: float,
+               latencies: list, errors: list, lock: threading.Lock) -> None:
+    for _ in range(n_requests):
+        req = urllib.request.Request(
+            url + "/predict", data=body,
+            headers={"Content-Type": "image/png"})
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = json.load(resp)
+                assert "classes" in payload and "probs" in payload
+            with lock:
+                latencies.append(time.time() - t0)
+        except Exception as e:  # noqa: BLE001 — count, keep loading
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+
+
+def summarize_serve_jsonl(path: str, since: float) -> dict:
+    """Server-side view from serve.jsonl: per-request records written by
+    vitax/serve/server.py (kind "serve_request") in the bench window."""
+    lat, wait, infer, occ = [], [], [], []
+    corrupt = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                corrupt += 1
+                continue
+            if (not isinstance(rec, dict)
+                    or rec.get("kind") != "serve_request"
+                    or rec.get("time", 0) < since):
+                continue
+            lat.append(rec["latency_s"])
+            wait.append(rec["queue_wait_s"])
+            infer.append(rec["infer_s"])
+            occ.append(rec["batch_size"] / max(rec["bucket"], 1))
+    lat.sort()
+    return {
+        "records": len(lat),
+        "corrupt_lines": corrupt,
+        "latency_s_p50": percentile(lat, 0.50),
+        "latency_s_p95": percentile(lat, 0.95),
+        "latency_s_p99": percentile(lat, 0.99),
+        "queue_wait_s_mean": (round(sum(wait) / len(wait), 6)
+                              if wait else None),
+        "infer_s_mean": (round(sum(infer) / len(infer), 6)
+                         if infer else None),
+        "batch_occupancy_mean": (round(sum(occ) / len(occ), 4)
+                                 if occ else None),
+    }
+
+
+def run_bench(url: str, concurrency: int, requests_per_worker: int,
+              image_size: int, timeout: float,
+              serve_jsonl: str = "") -> dict:
+    body = make_image_bytes(image_size)
+    latencies: list = []
+    errors: list = []
+    lock = threading.Lock()
+    t_start = time.time()
+    workers = [threading.Thread(
+        target=run_worker,
+        args=(url, body, requests_per_worker, timeout, latencies, errors,
+              lock), daemon=True)
+        for _ in range(concurrency)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    elapsed = time.time() - t_start
+    lat = sorted(latencies)
+    summary = {
+        "url": url,
+        "concurrency": concurrency,
+        "requests": concurrency * requests_per_worker,
+        "completed": len(lat),
+        "errors": len(errors),
+        "error_samples": errors[:3],
+        "elapsed_s": round(elapsed, 3),
+        "throughput_rps": round(len(lat) / max(elapsed, 1e-9), 3),
+        "latency_s_p50": percentile(lat, 0.50),
+        "latency_s_p95": percentile(lat, 0.95),
+        "latency_s_p99": percentile(lat, 0.99),
+        "latency_s_mean": (round(sum(lat) / len(lat), 6) if lat else None),
+    }
+    if serve_jsonl:
+        summary["server"] = summarize_serve_jsonl(serve_jsonl, since=t_start)
+    return summary
+
+
+def print_human(s: dict) -> None:
+    print(f"bench: {s['url']} x{s['concurrency']} closed-loop")
+    print(f"  {s['completed']}/{s['requests']} ok ({s['errors']} errors) "
+          f"in {s['elapsed_s']:.2f}s -> {s['throughput_rps']:.1f} req/s")
+    if s["latency_s_p50"] is not None:
+        print(f"  client latency: p50 {1e3 * s['latency_s_p50']:.1f}ms  "
+              f"p95 {1e3 * s['latency_s_p95']:.1f}ms  "
+              f"p99 {1e3 * s['latency_s_p99']:.1f}ms")
+    srv = s.get("server")
+    if srv and srv["records"]:
+        print(f"  server ({srv['records']} records): "
+              f"p50 {1e3 * srv['latency_s_p50']:.1f}ms  "
+              f"p99 {1e3 * srv['latency_s_p99']:.1f}ms  "
+              f"queue {1e3 * srv['queue_wait_s_mean']:.1f}ms  "
+              f"infer {1e3 * srv['infer_s_mean']:.1f}ms  "
+              f"occupancy {srv['batch_occupancy_mean']:.2f}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="closed-loop load generator for vitax.serve")
+    p.add_argument("--url", type=str, default="http://127.0.0.1:8000")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed-loop worker threads")
+    p.add_argument("--requests", type=int, default=100,
+                   help="requests per worker")
+    p.add_argument("--image_size", type=int, default=224,
+                   help="request image size (must match the served model)")
+    p.add_argument("--timeout", type=float, default=90.0,
+                   help="per-request client timeout (s)")
+    p.add_argument("--serve_jsonl", type=str, default="",
+                   help="server's serve.jsonl (--metrics_dir) to fold "
+                        "server-side latency/queue/occupancy into the report")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as one JSON object (CI mode)")
+    args = p.parse_args(argv)
+
+    summary = run_bench(args.url, args.concurrency, args.requests,
+                        args.image_size, args.timeout, args.serve_jsonl)
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print_human(summary)
+    return 0 if summary["errors"] == 0 and summary["completed"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

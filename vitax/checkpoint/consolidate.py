@@ -7,31 +7,97 @@ export of the full (unsharded) parameters for serving/analysis.
 Unlike the reference's tool, no shard metadata is needed — Orbax checkpoints are
 already topology-independent; this tool simply restores on host and flattens.
 
+The export is the direct input to the serving stack:
+`vitax.serve.InferenceEngine.from_npz` restores the exact param tree from it
+via the shared `flatten_tree` / `unflatten_tree` helpers below (see the
+README "Serving" section and vitax/serve/engine.py).
+
 Usage:
     python -m vitax.checkpoint.consolidate --ckpt_dir /path --epoch 10 --out full.npz
     python -m vitax.checkpoint.consolidate ... --params_only
+    python -m vitax.checkpoint.consolidate ... --dtype bfloat16   # half-size export
 """
 
 from __future__ import annotations
 
 import argparse
+from typing import Dict, Optional
 
 import numpy as np
 
 from vitax.checkpoint.orbax_io import epoch_ckpt_path
 
+# npz has no native bfloat16: bf16 arrays are stored as uint16 bit-views and
+# their keys recorded under this manifest entry, so load_npz can restore the
+# exact dtype. The key cannot collide with a param path ("/"-joined names).
+BF16_MANIFEST_KEY = "__bfloat16_keys__"
 
-def _flatten(tree, prefix=""):
+
+def flatten_tree(tree, sep: str = "/") -> Dict[str, np.ndarray]:
+    """Flatten a (nested-dict) param tree to {"a/b/c": np.ndarray}.
+
+    The inverse of `unflatten_tree`: consolidate writes with this and
+    `InferenceEngine.from_npz` reads with that, so the two sides share one
+    key convention by construction."""
     import jax
     out = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(
-            str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in path)
+        key = sep.join(
+            str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+            for p in path)
         out[key] = np.asarray(leaf)
     return out
 
 
-def consolidate(ckpt_dir: str, epoch: int, out: str, params_only: bool = True) -> dict:
+def unflatten_tree(flat: Dict[str, np.ndarray], sep: str = "/") -> dict:
+    """Rebuild the nested dict tree from flatten_tree's "/"-joined keys."""
+    tree: dict = {}
+    for key, leaf in flat.items():
+        parts = key.split(sep)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def save_npz(out: str, flat: Dict[str, np.ndarray],
+             dtype: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Write a flat tree as .npz, optionally casting every float array.
+
+    dtype "bfloat16" halves the export; bf16 has no npz dtype, so those
+    arrays are stored as uint16 bit-views plus a key manifest
+    (BF16_MANIFEST_KEY) that load_npz uses to restore them exactly."""
+    import ml_dtypes
+    if dtype:
+        target = (ml_dtypes.bfloat16 if dtype == "bfloat16"
+                  else np.dtype(dtype))
+        flat = {k: v.astype(target) if np.issubdtype(v.dtype, np.floating)
+                or v.dtype == ml_dtypes.bfloat16 else v
+                for k, v in flat.items()}
+    bf16_keys = sorted(k for k, v in flat.items()
+                       if v.dtype == ml_dtypes.bfloat16)
+    payload = {k: (v.view(np.uint16) if k in bf16_keys else v)
+               for k, v in flat.items()}
+    if bf16_keys:
+        payload[BF16_MANIFEST_KEY] = np.asarray(bf16_keys)
+    np.savez(out, **payload)
+    return flat
+
+
+def load_npz(path: str) -> Dict[str, np.ndarray]:
+    """Read a save_npz export back to {key: array}, restoring bf16 views."""
+    import ml_dtypes
+    with np.load(path) as data:
+        bf16 = (set(str(k) for k in data[BF16_MANIFEST_KEY])
+                if BF16_MANIFEST_KEY in data.files else set())
+        return {k: (data[k].view(ml_dtypes.bfloat16) if k in bf16
+                    else data[k])
+                for k in data.files if k != BF16_MANIFEST_KEY}
+
+
+def consolidate(ckpt_dir: str, epoch: int, out: str, params_only: bool = True,
+                dtype: Optional[str] = None) -> dict:
     import orbax.checkpoint as ocp
 
     from vitax.checkpoint.orbax_io import wait_until_finished
@@ -40,10 +106,11 @@ def consolidate(ckpt_dir: str, epoch: int, out: str, params_only: bool = True) -
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(path)  # host restore: full numpy arrays
     tree = state["params"] if params_only and "params" in state else state
-    flat = _flatten(tree)
-    np.savez(out, **flat)
+    flat = save_npz(out, flatten_tree(tree), dtype=dtype)
     total = sum(v.size for v in flat.values())
-    print(f"consolidated {len(flat)} arrays ({total:,} elements) from {path} -> {out}")
+    print(f"consolidated {len(flat)} arrays ({total:,} elements"
+          + (f", cast to {dtype}" if dtype else "")
+          + f") from {path} -> {out}")
     return flat
 
 
@@ -54,8 +121,15 @@ def main(argv=None):
     p.add_argument("--out", type=str, required=True)
     p.add_argument("--full_state", action="store_false", dest="params_only",
                    help="include optimizer state and step, not just params")
+    p.add_argument("--dtype", type=str, default=None,
+                   choices=["float32", "bfloat16"],
+                   help="cast float arrays for the export (default: keep "
+                        "the stored dtype). bfloat16 halves the file — the "
+                        "serving engine computes in bf16 anyway "
+                        "(vitax/serve/engine.py from_npz)")
     args = p.parse_args(argv)
-    consolidate(args.ckpt_dir, args.epoch, args.out, args.params_only)
+    consolidate(args.ckpt_dir, args.epoch, args.out, args.params_only,
+                dtype=args.dtype)
 
 
 if __name__ == "__main__":

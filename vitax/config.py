@@ -147,6 +147,14 @@ class Config:
     steps_per_epoch: int = 0            # override (0 = derive from dataset length // batch_size)
     max_steps: int = 0                  # hard stop after N optimizer steps (0 = no limit; for smoke/bench)
     eval_max_batches: int = 0           # cap val batches per eval (0 = full split, reference behavior)
+    # --- vitax: serving (vitax/serve/ — the inference half of the stack) ---
+    serve_port: int = 8000              # HTTP port for python -m vitax.serve (0 = ephemeral, tests)
+    serve_max_batch: int = 8            # largest micro-batch bucket (power of two); the engine
+    #   AOT-compiles every power-of-two bucket 1..serve_max_batch at startup
+    #   so steady-state traffic never recompiles (vitax/serve/engine.py)
+    max_batch_wait_ms: float = 5.0      # dynamic batcher flush deadline: a queued request waits at
+    #   most this long for the bucket to fill (vitax/serve/batcher.py)
+    serve_topk: int = 5                 # classes returned per /predict response
 
     @property
     def resolved_param_gather_dtype(self) -> str:
@@ -300,6 +308,27 @@ class Config:
             assert self.metrics_dir, (
                 "--tensorboard needs --metrics_dir: the TB event files live "
                 "under <metrics_dir>/tb next to the JSONL record they mirror")
+        assert self.eval_max_batches >= 0, (
+            f"--eval_max_batches must be >= 0 (0 = evaluate the full val "
+            f"split), got {self.eval_max_batches}: a negative cap would "
+            f"silently skip evaluation entirely")
+        assert 0 <= self.serve_port <= 65535, (
+            f"--serve_port must be in [0, 65535] (0 = ephemeral port, for "
+            f"tests), got {self.serve_port}")
+        assert self.serve_max_batch >= 1 and (
+            self.serve_max_batch & (self.serve_max_batch - 1)) == 0, (
+            f"--serve_max_batch must be a power of two >= 1, got "
+            f"{self.serve_max_batch}: the engine pads requests to "
+            f"power-of-two buckets (1, 2, 4, ...) and AOT-compiles each one "
+            f"at startup — a non-power-of-two cap would leave its own "
+            f"bucket uncompiled")
+        assert self.max_batch_wait_ms >= 0, (
+            f"--max_batch_wait_ms must be >= 0 (0 = flush every request "
+            f"immediately), got {self.max_batch_wait_ms}")
+        assert self.serve_topk >= 1, (
+            f"--serve_topk must be >= 1, got {self.serve_topk}; values above "
+            f"num_classes are clamped by the engine at load time "
+            f"(vitax/serve/engine.py)")
         assert self.resolved_param_gather_dtype in ("bfloat16", "float32"), (
             f"unknown param_gather_dtype {self.param_gather_dtype!r}")
         assert self.grad_reduce_dtype in ("bfloat16", "float32"), (
@@ -447,6 +476,21 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--steps_per_epoch", type=int, default=0)
     ext.add_argument("--max_steps", type=int, default=0)
     ext.add_argument("--eval_max_batches", type=int, default=0)
+    serve = parser.add_argument_group("vitax serving (vitax/serve/)")
+    serve.add_argument("--serve_port", type=int, default=8000,
+                       help="HTTP port for python -m vitax.serve "
+                            "(0 = ephemeral, for tests)")
+    serve.add_argument("--serve_max_batch", type=int, default=8,
+                       help="largest micro-batch bucket (power of two); "
+                            "every power-of-two bucket up to it is "
+                            "AOT-compiled at startup so steady-state "
+                            "traffic never recompiles")
+    serve.add_argument("--max_batch_wait_ms", type=float, default=5.0,
+                       help="dynamic batcher deadline: a queued request "
+                            "waits at most this long for the largest "
+                            "bucket to fill before the batch is flushed")
+    serve.add_argument("--serve_topk", type=int, default=5,
+                       help="classes returned per /predict response")
     return parser
 
 

@@ -1,0 +1,24 @@
+"""vitax.serve — TPU-native batched inference: checkpoint -> jitted
+eval-mode forward -> dynamic micro-batcher -> HTTP front end.
+
+    python -m vitax.serve --ckpt_dir /ckpts --epoch 10 --serve_port 8000 ...
+    python -m vitax.serve --npz full.npz ...
+
+See vitax/serve/engine.py (bucketed AOT forward), batcher.py (dynamic
+micro-batching), server.py (HTTP + telemetry), and the README "Serving"
+section.
+"""
+
+from vitax.serve.batcher import BatchResult, DynamicBatcher  # noqa: F401
+from vitax.serve.engine import (  # noqa: F401
+    InferenceEngine,
+    bucket_sizes,
+    next_bucket,
+)
+from vitax.serve.server import (  # noqa: F401
+    REQUIRED_SERVE_KEYS,
+    ServeMetrics,
+    serve_forever,
+    start_server,
+    stop_server,
+)

@@ -1,0 +1,216 @@
+"""InferenceEngine: checkpoint -> jitted eval-mode forward over bucketed batches.
+
+The inference half of the stack (ROADMAP north star: serve heavy traffic).
+Params come from either source the training side produces:
+
+- `from_checkpoint`: a sharded Orbax epoch checkpoint (vitax/checkpoint/
+  orbax_io.py) restored straight into the serving mesh layout — the abstract
+  target tree carries the same param_specs shardings training used, so a
+  checkpoint written on one topology serves on another;
+- `from_npz`: a consolidated single-file export (vitax/checkpoint/
+  consolidate.py), restored to the exact param tree via the shared
+  flatten_tree/unflatten_tree key convention, then device_put per-shard.
+
+The forward is eval-mode only (det=True: no dropout, no loss, no optimizer
+state — the restored opt_state is dropped on the floor so a 10B serve fits
+in a third of the training footprint) and is AOT-compiled once per
+power-of-two batch bucket (1, 2, 4, ..., serve_max_batch) at startup
+(`warmup`). Requests are padded to the next bucket, so steady-state traffic
+executes precompiled programs only: `compile_count` is exactly
+len(bucket_sizes) after warmup and never moves again — recompiles are
+structurally impossible because `predict` calls AOT executables, which
+reject any shape they were not compiled for (tests/test_serve.py pins this).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from vitax.config import Config
+from vitax.parallel.mesh import BATCH_AXES, Mesh, batch_pspec, build_mesh
+from vitax.utils.logging import master_print
+
+
+def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    """Power-of-two buckets 1, 2, 4, ..., max_batch (validate() guarantees
+    max_batch is itself a power of two)."""
+    sizes = []
+    b = 1
+    while b <= max_batch:
+        sizes.append(b)
+        b *= 2
+    return tuple(sizes)
+
+
+def next_bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket holding n requests (n must fit the largest bucket)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(
+        f"batch of {n} exceeds the largest bucket {buckets[-1]} "
+        f"(--serve_max_batch); the batcher never emits this")
+
+
+def _build_model(cfg: Config, mesh: Mesh):
+    """The same model construction the training loop performs (attention
+    impl + activation-sharding anchors included), so serving runs the
+    identical forward graph eval ran."""
+    from vitax.models import build_model
+    from vitax.ops.attention import make_attention_impl
+    from vitax.train.loop import _moe_dispatch_sharding, _token_sharding
+    return build_model(
+        cfg, attention_impl=make_attention_impl(cfg, mesh),
+        token_sharding=_token_sharding(cfg, mesh),
+        moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh))
+
+
+class InferenceEngine:
+    """Bucketed eval-mode forward: uint8 (B, H, W, 3) images -> top-k.
+
+    Thread-compatible by design: `predict` is called from the batcher's
+    single worker thread; construction/warmup happen before the server
+    accepts traffic.
+    """
+
+    def __init__(self, cfg: Config, mesh: Mesh, model, params):
+        assert getattr(cfg, "pp_size", 1) == 1, (
+            "serving v1 runs the non-pipelined forward; restore a pp "
+            "checkpoint with --pp_size 1 (Orbax reshards on load)")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.model = model
+        self.params = params
+        self.topk = min(cfg.serve_topk, cfg.num_classes)
+        self.buckets = bucket_sizes(cfg.serve_max_batch)
+        self.compile_count = 0          # warmup compiles; pinned by tests
+        self._compiled: Dict[int, jax.stages.Compiled] = {}
+        self._batch_shardings: Dict[int, NamedSharding] = {}
+        # batch-carrying device count: buckets divisible by it shard the
+        # batch; smaller buckets replicate (tiny inputs, sharded params)
+        self._batch_devices = 1
+        for ax in BATCH_AXES:
+            self._batch_devices *= mesh.shape.get(ax, 1)
+
+    # --- constructors -----------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, cfg: Config, ckpt_dir: Optional[str] = None,
+                        epoch: Optional[int] = None) -> "InferenceEngine":
+        """Restore params from a sharded Orbax epoch checkpoint (epoch None =
+        latest) directly into the serving mesh layout."""
+        from vitax.checkpoint.orbax_io import latest_epoch, restore_state
+        from vitax.train.state import build_optimizer, make_train_state
+        ckpt_dir = ckpt_dir or cfg.ckpt_dir
+        if epoch is None:
+            epoch = latest_epoch(ckpt_dir)
+            assert epoch is not None, f"no epoch checkpoint under {ckpt_dir}"
+        mesh = build_mesh(cfg)
+        model = _build_model(cfg, mesh)
+        # the abstract TrainState is the restore target (no device
+        # materialization); the optimizer exists only to shape it — its
+        # restored moments are dropped immediately below
+        tx, _ = build_optimizer(cfg, max_iteration=1)
+        abstract, _, _ = make_train_state(
+            cfg, model, tx, mesh, jax.random.key(cfg.seed), materialize=False)
+        state = restore_state(ckpt_dir, epoch, abstract)
+        engine = cls(cfg, mesh, model, state.params)
+        del state  # opt_state/step freed: serving holds params only
+        master_print(f"serve: params from Orbax checkpoint "
+                     f"{ckpt_dir} epoch {epoch}")
+        return engine
+
+    @classmethod
+    def from_npz(cls, cfg: Config, path: str) -> "InferenceEngine":
+        """Restore params from a consolidated .npz export
+        (vitax/checkpoint/consolidate.py) — the exact tree comes back through
+        the shared flatten/unflatten key convention, then every leaf is
+        device_put into its param_specs shard layout."""
+        from vitax.checkpoint.consolidate import load_npz, unflatten_tree
+        from vitax.parallel.sharding import param_specs, shardings_of
+        mesh = build_mesh(cfg)
+        model = _build_model(cfg, mesh)
+        params = unflatten_tree(load_npz(path))
+        shardings = shardings_of(mesh, param_specs(params, cfg, mesh))
+        params = jax.tree.map(jax.device_put, params, shardings)
+        master_print(f"serve: params from consolidated export {path}")
+        return cls(cfg, mesh, model, params)
+
+    # --- compilation ------------------------------------------------------
+
+    def _batch_sharding(self, bucket: int) -> NamedSharding:
+        if bucket % self._batch_devices == 0:
+            return NamedSharding(self.mesh, batch_pspec())
+        return NamedSharding(self.mesh, P())  # replicate sub-mesh buckets
+
+    def _predict_fn(self):
+        model, k = self.model, self.topk
+
+        def predict(params, images):
+            from vitax.train.step import prepare_images
+            logits = model.apply(params, prepare_images(images), True)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            top_p, top_i = jax.lax.top_k(probs, k)
+            return top_i.astype(jnp.int32), top_p
+
+        return predict
+
+    def _compile_bucket(self, bucket: int) -> jax.stages.Compiled:
+        from vitax.parallel.sharding import param_specs, shardings_of
+        batch_sh = self._batch_sharding(bucket)
+        param_sh = shardings_of(
+            self.mesh, param_specs(self.params, self.cfg, self.mesh))
+        fn = jax.jit(self._predict_fn(),
+                     in_shardings=(param_sh, batch_sh), out_shardings=None)
+        s = self.cfg.image_size
+        images = jax.ShapeDtypeStruct((bucket, s, s, 3), jnp.uint8,
+                                      sharding=batch_sh)
+        compiled = fn.lower(self.params, images).compile()
+        self.compile_count += 1
+        self._batch_shardings[bucket] = batch_sh
+        return compiled
+
+    def warmup(self) -> Dict[int, float]:
+        """AOT-compile every bucket and run each once (first execution pays
+        allocator/transfer setup). Returns {bucket: seconds} for the log."""
+        timings = {}
+        s = self.cfg.image_size
+        for b in self.buckets:
+            t0 = time.time()
+            self._compiled[b] = self._compile_bucket(b)
+            zeros = np.zeros((b, s, s, 3), np.uint8)
+            idx, probs = self._run(b, zeros)
+            jax.block_until_ready((idx, probs))
+            timings[b] = time.time() - t0
+        master_print("serve: warmup compiled buckets "
+                     + ", ".join(f"{b}:{t:.2f}s" for b, t in timings.items()))
+        return timings
+
+    # --- inference --------------------------------------------------------
+
+    def _run(self, bucket: int, images: np.ndarray):
+        batch = jax.device_put(images, self._batch_shardings[bucket])
+        return self._compiled[bucket](self.params, batch)
+
+    def predict(self, images: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(n, H, W, 3) uint8 -> (top-k class ids (n, k) int32,
+        top-k probs (n, k) float32). Pads to the next bucket; the padded
+        rows' outputs are discarded. Only precompiled buckets execute —
+        an unseen shape raises instead of silently recompiling."""
+        n = images.shape[0]
+        bucket = next_bucket(n, self.buckets)
+        assert bucket in self._compiled, (
+            f"bucket {bucket} not warmed up — call warmup() before serving")
+        if n < bucket:
+            padded = np.zeros((bucket,) + images.shape[1:], images.dtype)
+            padded[:n] = images
+            images = padded
+        top_i, top_p = self._run(bucket, images)
+        return np.asarray(top_i)[:n], np.asarray(top_p)[:n]

@@ -1,0 +1,296 @@
+"""HTTP front end: stdlib ThreadingHTTPServer over the engine + batcher.
+
+Endpoints:
+- POST /predict  — body is raw image bytes (any PIL-decodable format) or
+                   JSON {"image": <base64 image bytes>, "topk": <optional,
+                   <= --serve_topk>}; the image runs the SAME eval
+                   transforms training validation uses
+                   (vitax/data/transforms.py ValTransform), then the
+                   dynamic batcher; response is
+                   {"classes": [...], "probs": [...], "latency_ms": ...}.
+- GET /healthz   — liveness + the engine's compiled bucket set.
+- GET /metrics   — aggregate counters: requests/s, latency p50/p95/p99,
+                   queue wait, batch occupancy, queue depth.
+
+Observability rides the existing vitax.telemetry Recorder/sinks: one
+schema-versioned JSONL record per request (kind "serve_request") plus
+lifecycle events land in <metrics_dir>/serve.jsonl, summarized by
+tools/serve_bench.py --json for CI.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import sys
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from vitax.config import Config
+from vitax.serve.engine import InferenceEngine
+from vitax.serve.batcher import DynamicBatcher
+from vitax.utils.logging import master_print
+
+# acceptance contract of a serve_request record: tools/serve_bench.py and
+# tests/test_serve.py key off this exact set (beyond the Recorder's own
+# schema/time/kind/rank envelope)
+REQUIRED_SERVE_KEYS = (
+    "latency_s", "queue_wait_s", "infer_s", "batch_size", "bucket", "topk",
+)
+
+# a request outlives at most: its batcher deadline + one engine batch +
+# generous slack — beyond that the handler answers 503 instead of hanging
+# the client forever
+REQUEST_TIMEOUT_S = 60.0
+
+
+class ServeMetrics:
+    """Thread-safe aggregate counters behind GET /metrics."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self.started = time.time()
+        self.requests_total = 0
+        self.errors_total = 0
+        self._latency = deque(maxlen=window)
+        self._wait = deque(maxlen=window)
+        self._occupancy = deque(maxlen=window)  # batch_size / bucket
+        self._times = deque(maxlen=window)      # completion timestamps
+
+    def observe(self, latency_s: float, queue_wait_s: float,
+                batch_size: int, bucket: int) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self._latency.append(latency_s)
+            self._wait.append(queue_wait_s)
+            self._occupancy.append(batch_size / max(bucket, 1))
+            self._times.append(time.time())
+
+    def error(self) -> None:
+        with self._lock:
+            self.errors_total += 1
+
+    @staticmethod
+    def _pct(sorted_vals, q: float) -> Optional[float]:
+        if not sorted_vals:
+            return None
+        pos = (len(sorted_vals) - 1) * q
+        lo = int(pos)
+        hi = min(lo + 1, len(sorted_vals) - 1)
+        frac = pos - lo
+        return float(sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._latency)
+            waits = list(self._wait)
+            occ = list(self._occupancy)
+            times = list(self._times)
+            total, errors = self.requests_total, self.errors_total
+        now = time.time()
+        recent = [t for t in times if now - t <= 60.0]
+        return {
+            "requests_total": total,
+            "errors_total": errors,
+            "uptime_s": round(now - self.started, 3),
+            "requests_per_sec": round(total / max(now - self.started, 1e-9), 3),
+            "requests_per_sec_60s": round(len(recent) / 60.0, 3),
+            "latency_s_p50": self._pct(lat, 0.50),
+            "latency_s_p95": self._pct(lat, 0.95),
+            "latency_s_p99": self._pct(lat, 0.99),
+            "queue_wait_s_mean": (round(sum(waits) / len(waits), 6)
+                                  if waits else None),
+            "batch_occupancy_mean": (round(sum(occ) / len(occ), 4)
+                                     if occ else None),
+        }
+
+
+def build_serve_recorder(cfg: Config):
+    """Recorder writing schema-versioned serve.jsonl records through the
+    existing telemetry sinks, or None when --metrics_dir is unset. Fail-soft
+    like training telemetry: an unwritable dir disables recording, never
+    serving."""
+    import os
+    metrics_dir = getattr(cfg, "metrics_dir", "") or ""
+    if not metrics_dir:
+        return None
+    import jax
+    from vitax.telemetry.record import Recorder
+    from vitax.telemetry.sinks import JsonlSink
+    try:
+        os.makedirs(metrics_dir, exist_ok=True)
+        sinks = [JsonlSink(os.path.join(metrics_dir, "serve.jsonl"))]
+    except OSError as e:
+        print(f"vitax.serve: --metrics_dir {metrics_dir!r} is not writable "
+              f"({e}); serve telemetry disabled", file=sys.stderr, flush=True)
+        return None
+    return Recorder(cfg, sinks, jax.device_count(),
+                    jax.devices()[0].device_kind, rank=0)
+
+
+class ServeContext:
+    """Everything a handler thread needs, wired once at startup."""
+
+    def __init__(self, cfg: Config, engine: InferenceEngine, recorder=None):
+        from vitax.data.transforms import val_transform
+        self.cfg = cfg
+        self.engine = engine
+        self.recorder = recorder
+        self.metrics = ServeMetrics()
+        # normalize=False: the eval stack emits uint8 HWC and the engine's
+        # compiled program normalizes on device (vitax/train/step.py
+        # prepare_images) — the same split training uses
+        self.transform = val_transform(cfg.image_size, normalize=False)
+        from vitax.serve.engine import next_bucket
+        self.batcher = DynamicBatcher(
+            engine.predict, max_batch=cfg.serve_max_batch,
+            max_wait_ms=cfg.max_batch_wait_ms,
+            bucket_of=lambda n: next_bucket(n, engine.buckets),
+            on_batch=self._record_batch)
+
+    def _record_batch(self, stats: dict) -> None:
+        if self.recorder is not None:
+            self.recorder.event("serve_batch", **stats)
+
+    def decode(self, body: bytes, content_type: str):
+        """(uint8 HWC image, requested topk) from a /predict body."""
+        topk = self.engine.topk
+        if "application/json" in content_type:
+            payload = json.loads(body.decode("utf-8"))
+            raw = base64.b64decode(payload["image"])
+            if "topk" in payload:
+                topk = int(payload["topk"])
+                if not 1 <= topk <= self.engine.topk:
+                    raise ValueError(
+                        f"topk must be in [1, {self.engine.topk}] "
+                        f"(--serve_topk caps the compiled top-k)")
+        else:
+            raw = body
+        from PIL import Image
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        return self.transform(img), topk
+
+    def close(self) -> None:
+        self.batcher.close()
+        if self.recorder is not None:
+            self.recorder.close()
+
+
+def _make_handler(ctx: ServeContext):
+    class Handler(BaseHTTPRequestHandler):
+        # per-request access logging off: at serving rates stderr chatter is
+        # a throughput bug, and telemetry owns the durable record
+        def log_message(self, fmt, *args):  # noqa: A003
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "ok",
+                    "buckets": list(ctx.engine.buckets),
+                    "topk": ctx.engine.topk,
+                    "compile_count": ctx.engine.compile_count,
+                })
+            elif self.path == "/metrics":
+                snap = ctx.metrics.snapshot()
+                snap["queue_depth"] = ctx.batcher.queue_depth()
+                snap["batches_flushed"] = ctx.batcher.batches_flushed
+                snap["compile_count"] = ctx.engine.compile_count
+                self._reply(200, snap)
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            t0 = time.time()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                image, topk = ctx.decode(
+                    body, self.headers.get("Content-Type", ""))
+            except Exception as e:  # noqa: BLE001 — client error, not ours
+                ctx.metrics.error()
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                result = ctx.batcher.submit(image).result(
+                    timeout=REQUEST_TIMEOUT_S)
+            except Exception as e:  # noqa: BLE001
+                ctx.metrics.error()
+                self._reply(503, {"error": f"inference failed: {e}"})
+                return
+            latency_s = time.time() - t0
+            ctx.metrics.observe(latency_s, result.queue_wait_s,
+                                result.batch_size, result.bucket)
+            if ctx.recorder is not None:
+                ctx.recorder.event(
+                    "serve_request", latency_s=round(latency_s, 6),
+                    queue_wait_s=round(result.queue_wait_s, 6),
+                    infer_s=round(result.infer_s, 6),
+                    batch_size=result.batch_size, bucket=result.bucket,
+                    topk=topk)
+            self._reply(200, {
+                "classes": [int(c) for c in result.classes[:topk]],
+                "probs": [float(p) for p in result.probs[:topk]],
+                "latency_ms": round(latency_s * 1000.0, 3),
+            })
+
+    return Handler
+
+
+def start_server(cfg: Config, engine: InferenceEngine,
+                 port: Optional[int] = None):
+    """Warmed engine -> listening server (background thread).
+
+    Returns (httpd, ctx): httpd.server_address[1] is the bound port (pass
+    port=0 / --serve_port 0 for an ephemeral one — tests do). Call
+    `stop_server(httpd, ctx)` to drain and shut down."""
+    recorder = build_serve_recorder(cfg)
+    ctx = ServeContext(cfg, engine, recorder=recorder)
+    bind_port = cfg.serve_port if port is None else port
+    httpd = ThreadingHTTPServer(("0.0.0.0", bind_port), _make_handler(ctx))
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="vitax-serve-http")
+    thread.start()
+    if recorder is not None:
+        recorder.event("serve_start", port=httpd.server_address[1],
+                       buckets=list(engine.buckets), topk=engine.topk,
+                       max_batch_wait_ms=cfg.max_batch_wait_ms,
+                       compile_count=engine.compile_count)
+    master_print(f"serve: listening on :{httpd.server_address[1]} "
+                 f"(buckets {list(engine.buckets)}, "
+                 f"wait {cfg.max_batch_wait_ms}ms, top-{engine.topk})")
+    return httpd, ctx
+
+
+def stop_server(httpd, ctx: ServeContext) -> None:
+    httpd.shutdown()
+    httpd.server_close()
+    ctx.close()
+
+
+def serve_forever(cfg: Config, engine: InferenceEngine) -> None:
+    """Blocking entry point (python -m vitax.serve)."""
+    httpd, ctx = start_server(cfg, engine)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        master_print("serve: shutting down")
+    finally:
+        stop_server(httpd, ctx)

@@ -316,8 +316,9 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             # waits so training never exits with an uncommitted checkpoint
             save_state(cfg.ckpt_dir, epoch, state, wait=epoch == cfg.num_epochs)
         if epoch % cfg.test_epoch_interval == 0 or epoch == cfg.num_epochs:
-            accuracy, _, _ = eval_on_val(cfg, val_loader, eval_step, state)
-            master_print(f"accuracy on val: {accuracy:.4f}")
+            top1, top5, _, _ = eval_on_val(cfg, val_loader, eval_step, state,
+                                           recorder=recorder, epoch=epoch)
+            master_print(f"accuracy on val: {top1:.4f} (top-5 {top5:.4f})")
         if cfg.max_steps and total_steps >= cfg.max_steps:
             break
 
@@ -375,18 +376,32 @@ def _run_logging(cfg, epoch, step, loss, lr, smoothed_loss, smoothed_time):
     )
 
 
-def eval_on_val(cfg: Config, val_loader, eval_step, state: TrainState):
-    """Top-1 accuracy over the val split (reference eval_on_val,
-    run_vit_training.py:306-318). drop_last semantics preserved: the remainder
-    of the split is ignored, exactly like the reference (:77,:83)."""
+def eval_on_val(cfg: Config, val_loader, eval_step, state: TrainState,
+                recorder=None, epoch: int = 0):
+    """Top-1 + top-5 accuracy over the val split (reference eval_on_val,
+    run_vit_training.py:306-318, extended with the top-5 metric the serving
+    stack reports). drop_last semantics preserved: the remainder of the
+    split is ignored, exactly like the reference (:77,:83).
+
+    With a Recorder (--metrics_dir), emits one kind:"eval" telemetry event
+    (epoch, top1, top5, n) per eval pass — tools/metrics_report.py surfaces
+    the latest one. Returns (top1, top5, n_correct, total)."""
     correct = None
     total = 0
     for step, batch in enumerate(val_loader.epoch(0)):
         if cfg.eval_max_batches and step >= cfg.eval_max_batches:
             break
         c = eval_step(state, batch)
-        correct = c if correct is None else correct + c
+        correct = c if correct is None else jax.tree.map(
+            lambda a, b: a + b, correct, c)
         total += cfg.batch_size
-    n_correct = int(jax.device_get(correct)) if correct is not None else 0
-    accuracy = n_correct / total if total else 0.0
-    return accuracy, n_correct, total
+    counts = (jax.device_get(correct) if correct is not None
+              else {"correct": 0, "correct_top5": 0})
+    n_correct = int(counts["correct"])
+    n_top5 = int(counts["correct_top5"])
+    top1 = n_correct / total if total else 0.0
+    top5 = n_top5 / total if total else 0.0
+    if recorder is not None:
+        recorder.event("eval", epoch=int(epoch), top1=top1, top5=top5,
+                       n=total)
+    return top1, top5, n_correct, total

@@ -384,23 +384,32 @@ def make_train_step(
 
 
 def make_eval_step(cfg: Config, model, mesh: Mesh, state_specs: PyTree):
-    """Jitted eval step: (state, batch) -> correct-prediction count over the
-    global batch (reference eval_on_val's device-side accumulator + mesh_reduce,
-    run_vit_training.py:306-318, as one compiled reduction)."""
+    """Jitted eval step: (state, batch) -> {"correct", "correct_top5"}
+    prediction counts over the global batch (reference eval_on_val's
+    device-side accumulator + mesh_reduce, run_vit_training.py:306-318, as
+    one compiled reduction; top-5 rides the same compiled program via
+    lax.top_k — with < 5 classes, k clamps and top-5 equals top-k)."""
     state_shardings = shardings_of(mesh, state_specs)
     batch_sharding = NamedSharding(mesh, batch_pspec())
     forward = _forward_fn(cfg, model, mesh, state_specs)
     comm = make_comm_precision(cfg, mesh, state_specs.params)
 
     anchor_logits = _make_logits_anchor(mesh)
+    k5 = min(5, cfg.num_classes)
 
     def eval_step(state: TrainState, batch):
         params = state.params if comm is None else comm.cast(state.params)
         logits = forward(params, prepare_images(batch["image"]), True)
         # same batch-sharded logits anchor as the train loss (the argmax
         # iota is the eval-side victim of the mixed layout)
-        pred = jnp.argmax(anchor_logits(logits), axis=-1)
-        return jnp.sum((pred == batch["label"]).astype(jnp.int32))
+        logits = anchor_logits(logits)
+        pred = jnp.argmax(logits, axis=-1)
+        _, top5 = jax.lax.top_k(logits, k5)
+        in_top5 = jnp.any(top5 == batch["label"][:, None], axis=-1)
+        return {
+            "correct": jnp.sum((pred == batch["label"]).astype(jnp.int32)),
+            "correct_top5": jnp.sum(in_top5.astype(jnp.int32)),
+        }
 
     return jax.jit(
         eval_step,
