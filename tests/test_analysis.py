@@ -1,0 +1,453 @@
+"""vitax.analysis: parser units, rule positive/negative cases, AST lint.
+
+Strategy: hand-written HLO/MLIR string fixtures drive the parser units and
+every rule's NEGATIVE case (deliberately broken programs — a use-site gather,
+an f32 gather under the bf16 policy, an outfeed in the step, a replicated
+large param), so each rule provably FAILS on the violation it polices. The
+POSITIVE cases run the real rules over real lowered programs (session-scoped:
+one overlap train arm, one donation-off arm, one warmed serve engine), which
+doubles as the end-to-end check that HEAD itself is clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from vitax.analysis import ast_lint, hlo, rules
+from vitax.analysis.rules import (
+    COLLECTIVE_DTYPE,
+    DONATION_HONORED,
+    GATHER_OVERLAP,
+    NO_HOST_TRANSFER,
+    NO_REPLICATED_LARGE,
+    SERVE_NO_RECOMPILE,
+    Program,
+    arm_config,
+    build_serve_program,
+    build_train_program,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- HLO fixtures ------------------------------------------------------------
+
+# A minimal partitioned-style module: a while loop whose body issues one
+# all-gather consumed by a dot before the carry (a USE-SITE gather — the
+# serial ZeRO-3 schedule).
+HLO_USE_SITE = textwrap.dedent("""\
+    HloModule jit_train_step, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }
+
+    body.1 {
+      p.1 = (f32[8,8], f32[8,8]) parameter(0)
+      gte.0 = f32[8,8] get-tuple-element(p.1), index=0
+      gte.1 = f32[8,8] get-tuple-element(p.1), index=1
+      ag.1 = f32[8,8] all-gather(gte.0), dimensions={0}
+      dot.1 = f32[8,8] dot(ag.1, gte.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+      ROOT tuple.1 = (f32[8,8], f32[8,8]) tuple(dot.1, gte.1)
+    }
+
+    cond.1 {
+      cp.1 = (f32[8,8], f32[8,8]) parameter(0)
+      ROOT lt.1 = pred[] constant(false)
+    }
+
+    ENTRY main.1 {
+      param.0 = f32[8,8] parameter(0)
+      param.1 = f32[8,8] parameter(1)
+      t.0 = (f32[8,8], f32[8,8]) tuple(param.0, param.1)
+      w.1 = (f32[8,8], f32[8,8]) while(t.0), condition=cond.1, body=body.1
+      ROOT out.0 = f32[8,8] get-tuple-element(w.1), index=0
+    }
+    """)
+
+# Same loop but the gather's result rides the carry to ROOT through nothing
+# but plumbing — the prefetch-slot schedule.
+HLO_PREFETCH = HLO_USE_SITE.replace(
+    "ROOT tuple.1 = (f32[8,8], f32[8,8]) tuple(dot.1, gte.1)",
+    "cp2.1 = f32[8,8] copy(ag.1)\n"
+    "  ROOT tuple.1 = (f32[8,8], f32[8,8]) tuple(dot.1, cp2.1)")
+
+HLO_WITH_OUTFEED = HLO_USE_SITE.replace(
+    "ROOT out.0 = f32[8,8] get-tuple-element(w.1), index=0",
+    "tok.0 = token[] after-all()\n"
+    "  of.1 = token[] outfeed(param.0, tok.0), outfeed_config=\"x\"\n"
+    "  cc.1 = () custom-call(param.1), custom_call_target=\"xla_python_cpu_callback\"\n"
+    "  ROOT out.0 = f32[8,8] get-tuple-element(w.1), index=0")
+
+
+def mk_mlir(args):
+    """StableHLO @main skeleton from [(type, attr_dict_text or None)]."""
+    rendered = ", ".join(
+        f"%arg{i}: {ty}" + (f" {{{attrs}}}" if attrs else "")
+        for i, (ty, attrs) in enumerate(args))
+    return textwrap.dedent(f"""\
+        module @jit_train_step attributes {{mhlo.num_partitions = 8 : i32}} {{
+          func.func public @main({rendered}) -> (tensor<f32>) {{
+            %0 = stablehlo.constant dense<0.0> : tensor<f32>
+            return %0 : tensor<f32>
+          }}
+        }}
+        """)
+
+
+SHARDED = 'mhlo.sharding = "{devices=[8,1]<=[8]}"'
+REPLICATED = 'mhlo.sharding = "{replicated}"'
+
+
+# --- parser units ------------------------------------------------------------
+
+
+def test_collect_collectives_and_bytes():
+    rows = hlo.collect_collectives(
+        "  a = bf16[2,32]{1,0} all-gather(x), dims={0}\n"
+        "  b = bf16[2,32]{1,0} all-gather(y), dims={0}\n"
+        "  c = f32[16]{0} reduce-scatter(z), dims={0}\n"
+        "  d = f32[4,4]{1,0} all-reduce-start(w), to_apply=add\n")
+    by_op = {r["op"]: r for r in rows}
+    assert by_op["all-gather"]["count"] == 2
+    assert by_op["all-gather"]["dtype"] == "bf16"
+    assert by_op["all-gather"]["bytes"] == 2 * 64 * 2
+    assert by_op["reduce-scatter"]["bytes"] == 16 * 4
+    assert "all-reduce" in by_op  # -start folded into the base op
+    assert hlo.gather_bytes(rows) == 256
+    assert hlo.gather_bytes(rows, dtype="f32") == 0
+    totals = hlo.summarize(rows)
+    assert totals["all-gather"]["by_dtype"]["bf16"]["count"] == 2
+
+
+def test_split_computations_and_inventory():
+    comps = hlo.split_computations(HLO_USE_SITE)
+    assert set(comps) == {"body.1", "cond.1", "main.1"}
+    assert len(comps["body.1"]) == 6
+    inv = hlo.while_body_op_inventory(HLO_USE_SITE)
+    assert inv["body.1"]["all-gather"] == 1
+    assert inv["body.1"]["dot"] == 1
+
+
+def test_overlap_verdict_use_site_vs_prefetch():
+    use = hlo.overlap_verdict(HLO_USE_SITE)
+    assert use["per_iteration_gather_count"] == {"body.1": 1}
+    assert use["prefetch_slot_gathers"] == 0
+    pre = hlo.overlap_verdict(HLO_PREFETCH)
+    assert pre["per_iteration_gather_count"] == {"body.1": 1}
+    assert pre["prefetch_slot_gathers"] == 1
+
+
+def test_input_output_aliases_header():
+    aliases = hlo.input_output_aliases(HLO_USE_SITE)
+    assert [(a["output_index"], a["parameter"]) for a in aliases] == \
+        [((0,), 0), ((1,), 1)]
+    assert hlo.input_output_aliases("HloModule bare\n") == []
+
+
+def test_host_transfer_ops():
+    assert hlo.host_transfer_ops(HLO_USE_SITE) == []
+    ops = hlo.host_transfer_ops(HLO_WITH_OUTFEED)
+    assert [o["op"] for o in ops] == ["outfeed", "custom-call"]
+    assert ops[1]["detail"] == "xla_python_cpu_callback"
+    mops = hlo.mlir_host_transfer_ops(
+        '    stablehlo.custom_call @xla_python_cpu_callback(%1) : x\n')
+    assert mops and mops[0]["detail"] == "xla_python_cpu_callback"
+
+
+def test_mlir_main_args_table():
+    text = mk_mlir([
+        ("tensor<64x64xf32>", SHARDED + ", tf.aliasing_output = 0 : i32"),
+        ("tensor<8xf32>", REPLICATED + ", tf.aliasing_output = 1 : i32"),
+        ("tensor<64x16x16x3xui8>", None),
+    ])
+    args = hlo.mlir_main_args(text)
+    assert [a["index"] for a in args] == [0, 1, 2]
+    assert args[0]["bytes"] == 64 * 64 * 4
+    assert args[0]["donated_to"] == 0
+    assert not hlo.sharding_is_replicated(args[0]["sharding"])
+    assert hlo.sharding_is_replicated(args[1]["sharding"])
+    assert args[2]["donated_to"] is None
+    assert args[2]["sharding"] is None
+    assert hlo.sharding_is_replicated(args[2]["sharding"])  # unannotated
+
+
+def test_sharding_is_replicated_tiled_forms():
+    assert hlo.sharding_is_replicated(
+        "{devices=[1,1,8]<=[8] last_tile_dim_replicate}")
+    assert not hlo.sharding_is_replicated("{devices=[8,1]<=[8]}")
+
+
+# --- real lowered programs (session-scoped: ~10s each) -----------------------
+
+
+@pytest.fixture(scope="session")
+def overlap_program(devices8):
+    return build_train_program(
+        arm_config("zero3_overlap"), arm="zero3_overlap")
+
+
+@pytest.fixture(scope="session")
+def no_donate_program(devices8):
+    return build_train_program(
+        arm_config("zero3"), arm="zero3_nodonate", donate=False)
+
+
+@pytest.fixture(scope="session")
+def serve_program(devices8):
+    return build_serve_program(arm_config("serve"))
+
+
+# --- per-rule positive + negative cases --------------------------------------
+
+
+def test_r001_host_transfer_positive(overlap_program):
+    assert NO_HOST_TRANSFER.check(
+        overlap_program, overlap_program.config) == []
+
+
+def test_r001_host_transfer_negative(overlap_program):
+    broken = Program(kind="train", arm="x", config=overlap_program.config,
+                     partitioned_hlo=HLO_WITH_OUTFEED)
+    findings = NO_HOST_TRANSFER.check(broken, broken.config)
+    assert len(findings) == 2
+    assert all(f.rule == "VTX-R001" and f.severity == "ERROR"
+               for f in findings)
+
+
+def test_r002_donation_positive(overlap_program):
+    assert overlap_program.n_state_leaves > 0
+    assert DONATION_HONORED.check(
+        overlap_program, overlap_program.config) == []
+
+
+def test_r002_donation_negative_donate_off(no_donate_program):
+    findings = DONATION_HONORED.check(
+        no_donate_program, no_donate_program.config)
+    assert findings, "donation disabled must trip VTX-R002"
+    assert findings[0].rule == "VTX-R002"
+    assert findings[0].details["donated"] == 0
+
+
+def test_r003_collective_dtype_positive(overlap_program):
+    assert overlap_program.config.comm_cast_active
+    assert COLLECTIVE_DTYPE.check(
+        overlap_program, overlap_program.config) == []
+
+
+def test_r003_collective_dtype_negative():
+    cfg = arm_config("zero3")  # bf16 policy active, embed_dim=32
+    assert COLLECTIVE_DTYPE.applies_to(cfg)
+    d = cfg.embed_dim
+    broken = Program(
+        kind="train", arm="x", config=cfg,
+        partitioned_hlo=f"  ag = f32[{d},{d}]{{1,0}} all-gather(p), dims={{0}}\n")
+    findings = COLLECTIVE_DTYPE.check(broken, cfg)
+    assert len(findings) == 1 and findings[0].rule == "VTX-R003"
+    # sub-threshold f32 gathers (bias-sized) stay legal
+    small = Program(
+        kind="train", arm="x", config=cfg,
+        partitioned_hlo=f"  ag = f32[{d}]{{0}} all-gather(p), dims={{0}}\n")
+    assert COLLECTIVE_DTYPE.check(small, cfg) == []
+
+
+def test_r003_not_applicable_without_policy():
+    assert not COLLECTIVE_DTYPE.applies_to(arm_config("dp"))
+
+
+def test_r004_gather_overlap_positive(overlap_program):
+    assert GATHER_OVERLAP.applicable(overlap_program)
+    assert GATHER_OVERLAP.check(
+        overlap_program, overlap_program.config) == []
+
+
+def test_r004_gather_overlap_negative():
+    cfg = arm_config("zero3_overlap")
+    broken = Program(kind="train", arm="x", config=cfg,
+                     partitioned_hlo=HLO_USE_SITE,
+                     mesh_shape={"dp": 1, "fsdp": 8})
+    findings = GATHER_OVERLAP.check(broken, cfg)
+    assert len(findings) == 1 and findings[0].rule == "VTX-R004"
+    assert "use-site" in findings[0].message
+    ok = Program(kind="train", arm="x", config=cfg,
+                 partitioned_hlo=HLO_PREFETCH,
+                 mesh_shape={"dp": 1, "fsdp": 8})
+    assert GATHER_OVERLAP.check(ok, cfg) == []
+
+
+def test_r005_replicated_large_positive(overlap_program):
+    assert NO_REPLICATED_LARGE.applicable(overlap_program)
+    assert NO_REPLICATED_LARGE.check(
+        overlap_program, overlap_program.config) == []
+
+
+def test_r005_replicated_large_negative():
+    cfg = arm_config("zero3")
+    d = cfg.embed_dim  # threshold is d*d*4 bytes; a d*d f32 donated arg tips it
+    broken = Program(
+        kind="train", arm="x", config=cfg,
+        mlir=mk_mlir([
+            (f"tensor<{d}x{d}xf32>",
+             REPLICATED + ", tf.aliasing_output = 0 : i32"),
+            (f"tensor<{d}x{d}xf32>",
+             SHARDED + ", tf.aliasing_output = 1 : i32"),
+        ]),
+        mesh_shape={"dp": 1, "fsdp": 8})
+    findings = NO_REPLICATED_LARGE.check(broken, cfg)
+    assert len(findings) == 1 and findings[0].rule == "VTX-R005"
+    assert findings[0].details["arg"]["index"] == 0
+
+
+def test_r006_serve_positive(serve_program):
+    assert SERVE_NO_RECOMPILE.check(
+        serve_program, serve_program.config) == []
+
+
+def test_r006_serve_negative(serve_program):
+    class LeakyEngine:
+        """compile_count drifted past the bucket set: recompiles happened."""
+        buckets = (1,)
+        compile_count = 3
+        params = None
+        _compiled = {1: lambda *a, **k: None}  # accepts anything: also bad
+        _batch_shardings = {1: None}
+
+        def predict(self, images):
+            return None, None
+
+    broken = Program(kind="serve", arm="serve", config=serve_program.config,
+                     engine=LeakyEngine())
+    findings = SERVE_NO_RECOMPILE.check(broken, broken.config)
+    codes = [f.message for f in findings]
+    assert any("compile_count 3 != bucket count 1" in m for m in codes)
+    assert any("accepted an unseen input shape" in m for m in codes)
+
+
+def test_run_rules_dispatch(overlap_program, serve_program):
+    ran, findings = rules.run_rules(overlap_program)
+    assert ran == ["VTX-R001", "VTX-R002", "VTX-R003", "VTX-R004", "VTX-R005"]
+    assert findings == []
+    ran_s, findings_s = rules.run_rules(serve_program)
+    assert ran_s == ["VTX-R006"] and findings_s == []
+
+
+def test_comm_audit_reexports():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import comm_audit
+    for name in ("collect_collectives", "summarize", "gather_bytes",
+                 "overlap_verdict", "partitioned_hlo_text", "audit_config",
+                 "format_report", "main"):
+        assert callable(getattr(comm_audit, name)), name
+    assert comm_audit.collect_collectives is hlo.collect_collectives
+
+
+# --- check_invariants CLI (subprocess: one arm, ~20s) ------------------------
+
+
+def test_check_invariants_json_schema(devices8):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_invariants.py"),
+         "--arms", "zero3", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["schema"] == 1
+    assert set(doc) == {"schema", "arms", "findings", "errors", "ok"}
+    assert doc["ok"] is True and doc["errors"] == {}
+    arm = doc["arms"]["zero3"]
+    assert set(arm) == {"ok", "rules_ran", "findings"}
+    assert arm["rules_ran"] == ["VTX-R001", "VTX-R002", "VTX-R003", "VTX-R005"]
+    assert arm["findings"] == []
+
+
+# --- AST lint ----------------------------------------------------------------
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def test_lint_device_get_in_traced_module():
+    src = "import jax\ndef f(x):\n    return jax.device_get(x)\n"
+    assert _codes(ast_lint.lint_source(src, "vitax/models/vit.py")) == ["VTX101"]
+    # same construct outside the traced set is fine
+    assert ast_lint.lint_source(src, "vitax/telemetry/record.py") == []
+
+
+def test_lint_block_until_ready_and_float_on_traced():
+    src = ("import jax, jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    y = jnp.sum(x).block_until_ready()\n"
+           "    return float(jnp.mean(y))\n")
+    assert _codes(ast_lint.lint_source(src, "vitax/train/step.py")) == \
+        ["VTX101", "VTX102"]
+
+
+def test_lint_item_on_traced():
+    src = "import jax.numpy as jnp\ndef f(x):\n    return jnp.max(x).item()\n"
+    assert _codes(ast_lint.lint_source(src, "vitax/ops/attention.py")) == \
+        ["VTX102"]
+    # .item() on a non-jax object is not flagged
+    src2 = "def f(d):\n    return d.item()\n"
+    assert ast_lint.lint_source(src2, "vitax/ops/attention.py") == []
+
+
+def test_lint_unfenced_timing():
+    src = ("import time\n"
+           "def loop(step_fn, batch):\n"
+           "    t0 = time.time()\n"
+           "    out = step_fn(batch)\n"
+           "    dt = time.time() - t0\n"
+           "    return out, dt\n")
+    assert _codes(ast_lint.lint_source(src, "vitax/train/loop.py")) == ["VTX103"]
+    fenced = src.replace("    dt = time.time() - t0\n",
+                         "    jax.block_until_ready(out)\n"
+                         "    dt = time.time() - t0\n")
+    assert ast_lint.lint_source(fenced, "vitax/train/loop.py") == []
+
+
+def test_lint_argless_jax_devices():
+    src = "import jax\ndef f():\n    return jax.devices()[0]\n"
+    assert _codes(ast_lint.lint_source(src, "vitax/serve/server.py")) == \
+        ["VTX104"]
+    ok = "import jax\ndef f():\n    return jax.devices('cpu')[0]\n"
+    assert ast_lint.lint_source(ok, "vitax/serve/server.py") == []
+
+
+def test_lint_mutable_default():
+    src = "def f(xs=[], m={}):\n    return xs, m\n"
+    assert _codes(ast_lint.lint_source(src, "vitax/data/loader.py")) == \
+        ["VTX105", "VTX105"]
+
+
+def test_lint_suppression_with_reason():
+    src = ("import jax\n"
+           "def f():\n"
+           "    return jax.devices()[0]  "
+           "# vtx: ignore[VTX104] test needs the live device list\n")
+    assert ast_lint.lint_source(src, "vitax/serve/server.py") == []
+
+
+def test_lint_bare_suppression_is_error():
+    src = ("import jax\n"
+           "def f():\n"
+           "    return jax.devices()[0]  # vtx: ignore[VTX104]\n")
+    codes = _codes(ast_lint.lint_source(src, "vitax/serve/server.py"))
+    assert "VTX100" in codes  # bare suppression flagged
+    assert "VTX104" in codes  # and it does NOT suppress
+
+
+def test_lint_repo_is_clean():
+    findings = ast_lint.lint_paths([os.path.join(REPO, "vitax")])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_lint_cli(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text("def f(xs=[]):\n    return xs\n")
+    assert ast_lint.main([str(bad)]) == 1
+    assert ast_lint.main([str(bad), "--json"]) == 1
+    good = tmp_path / "ok.py"
+    good.write_text("def f(xs=None):\n    return xs or []\n")
+    assert ast_lint.main([str(good)]) == 0

@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""CI gate: verify compiled-program invariants across parallelism arms.
+
+CPU-AOT-lowers the train step for each parallelism arm (dp / zero2 / zero3 /
+zero3_overlap / accum / moe — plus a warmed-up serve engine), then runs every
+applicable rule from vitax.analysis.rules over the lowered StableHLO and the
+post-`spmd-partitioning` HLO. The partitioned module is the real program
+(GSPMD lineage): properties like "gathers are bf16", "state buffers are
+donated", "no host transfer inside the step" are only checkable there, and
+this gate is what keeps future refactors from silently regressing them.
+
+Usage:
+    python tools/check_invariants.py                  # all arms, human report
+    python tools/check_invariants.py --arms zero3_overlap serve
+    python tools/check_invariants.py --json           # machine-readable
+
+JSON contract (schema 1):
+    {"schema": 1,
+     "arms": {"<arm>": {"ok": bool, "rules_ran": [rule ids],
+                        "findings": [{rule, severity, arm, message, details}]}},
+     "findings": [...all findings...],
+     "errors": {"<arm>": "<traceback tail>"},   # arms that failed to build
+     "ok": bool}
+
+Exit status: 0 when every requested arm built and produced no ERROR-severity
+finding; 1 otherwise. WARN findings are reported but do not fail the gate.
+"""
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Must precede any jax import: the arms shard over an 8-device host mesh.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run(arms, as_json):
+    from vitax.analysis import rules as R
+
+    report = {"schema": 1, "arms": {}, "findings": [], "errors": {}, "ok": True}
+    for arm in arms:
+        t0 = time.time()
+        try:
+            # library chatter (serve warmup timings) must not precede the
+            # JSON document on stdout
+            guard = (contextlib.redirect_stdout(sys.stderr) if as_json
+                     else contextlib.nullcontext())
+            with guard:
+                program = R.build_program(arm)
+                ran, findings = R.run_rules(program)
+        except Exception:
+            tb = traceback.format_exc().strip().splitlines()
+            report["errors"][arm] = "\n".join(tb[-3:])
+            report["ok"] = False
+            if not as_json:
+                print(f"[{arm}] BUILD FAILED:\n" + "\n".join(tb[-3:]),
+                      file=sys.stderr)
+            continue
+        rows = [f.to_json() for f in findings]
+        arm_ok = not any(f.severity == "ERROR" for f in findings)
+        report["arms"][arm] = {"ok": arm_ok, "rules_ran": ran,
+                               "findings": rows}
+        report["findings"].extend(rows)
+        report["ok"] = report["ok"] and arm_ok
+        if not as_json:
+            status = "ok" if arm_ok else "FAIL"
+            print(f"[{arm}] {status} ({time.time() - t0:.1f}s) — "
+                  f"rules: {', '.join(ran) if ran else 'none applicable'}")
+            for f in findings:
+                print(f"    {f.rule} [{f.severity}] {f.message}")
+    return report
+
+
+def main(argv=None):
+    from vitax.analysis import rules as R
+
+    parser = argparse.ArgumentParser(
+        prog="tools/check_invariants.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--arms", nargs="+", choices=list(R.ALL_ARMS),
+                        default=list(R.ALL_ARMS),
+                        help="parallelism arms to verify (default: all)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the JSON CI contract on stdout")
+    args = parser.parse_args(argv)
+
+    report = run(args.arms, args.as_json)
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    elif report["ok"]:
+        print("check_invariants: all arms clean")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

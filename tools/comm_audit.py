@@ -24,259 +24,42 @@ at the ZeRO-2 step-top gather of the whole param tree (~2x total gather
 bytes), plus once-per-step casting and bf16 scan carries instead of per-slice
 converts.
 
+The HLO/while-body parser lives in vitax.analysis.hlo (it started here and
+was generalized for the rule registry in vitax.analysis.rules); this tool is
+now a thin CLI over it. The re-exports below keep the historical module-level
+API (`from tools.comm_audit import audit_config`, `comm_audit.gather_bytes`)
+stable for the tier-1 tests.
+
 Usage:
     python tools/comm_audit.py --embed_dim 1024 --num_blocks 24 [vitax flags]
     python tools/comm_audit.py ... --json          # machine-readable report
     python tools/comm_audit.py ... --compare       # vs the f32 gather policy
 """
 
-import collections
-import glob
 import json
 import os
-import re
-import shutil
 import sys
-import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# `= bf16[2,32,128]{...} all-gather(` — dtype, shape, op from a partitioned-HLO
-# instruction line. `-start` variants cover async collectives; `-done` halves
-# carry no shape of their own and are skipped.
-COLLECTIVE_RE = re.compile(
-    r"= (\w+)\[([\d,]*)\][^ ]* "
-    r"((?:all-gather|all-reduce|reduce-scatter|all-to-all|collective-permute)"
-    r"(?:-start)?)\(")
+from vitax.analysis.hlo import (  # noqa: E402  (sys.path fix must precede)
+    COLLECTIVE_RE,
+    DTYPE_BYTES,
+    INSTR_RE as _INSTR_RE,
+    TRIVIAL_OPS as _TRIVIAL_OPS,
+    collect_collectives,
+    gather_bytes,
+    overlap_verdict,
+    partitioned_hlo_text,
+    split_computations as _split_computations,
+    summarize,
+)
 
-DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "f16": 2, "bf16": 2, "s16": 2, "u16": 2,
-    "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8,
-}
-
-
-def collect_collectives(hlo_text):
-    """Parse a partitioned-HLO module into aggregated collective rows.
-
-    Returns a list of dicts {op, dtype, shape, count, bytes} where `bytes` is
-    count * output-shape bytes. Output-shape bytes is the honest per-step
-    proxy for wire traffic: an all-gather's output is the gathered tensor
-    every participant materializes, an all-reduce/reduce-scatter's output is
-    what the reduction moves. (Exact wire bytes carry an extra (n-1)/n ring
-    factor that is identical across policies and so cancels in every ratio
-    this tool is used for.)
-    """
-    rows = collections.Counter()
-    for m in COLLECTIVE_RE.finditer(hlo_text):
-        dtype, shape_s, op = m.groups()
-        shape = tuple(int(d) for d in shape_s.split(",") if d)
-        rows[(op.replace("-start", ""), dtype, shape)] += 1
-    out = []
-    for (op, dtype, shape), count in sorted(rows.items()):
-        numel = 1
-        for d in shape:
-            numel *= d
-        out.append({
-            "op": op, "dtype": dtype, "shape": list(shape), "count": count,
-            "numel": numel,
-            "bytes": count * numel * DTYPE_BYTES.get(dtype, 4),
-        })
-    return out
-
-
-def summarize(rows):
-    """Totals per op kind, split by element type."""
-    totals = {}
-    for r in rows:
-        slot = totals.setdefault(r["op"], {"count": 0, "bytes": 0, "by_dtype": {}})
-        slot["count"] += r["count"]
-        slot["bytes"] += r["bytes"]
-        d = slot["by_dtype"].setdefault(r["dtype"], {"count": 0, "bytes": 0})
-        d["count"] += r["count"]
-        d["bytes"] += r["bytes"]
-    return totals
-
-
-# ops a value may pass through on its way to the while body's ROOT tuple and
-# still count as "sitting on the carry": layout/dtype plumbing, not compute.
-# A gather whose result reaches ROOT only through these feeds the next
-# iteration's prefetch slot; a gather consumed by a dot/fusion first is a
-# use-site gather.
-_TRIVIAL_OPS = frozenset({
-    "copy", "convert", "bitcast", "bitcast-convert", "reshape", "transpose",
-    "get-tuple-element", "tuple", "optimization-barrier", "all-gather-done",
-})
-
-# `  ROOT name = type op(a, b), attrs...` — name, op, operand list of one
-# instruction line. Handles both dump styles: the verbose one (`%name = f32[2]
-# add(%a, %b)`) and the terse one XLA emits for pass dumps (`add.3 = f32[2]
-# add(p.1, p.2)`); the type may itself be a parenthesised tuple, so the op is
-# "the first bare word directly followed by ( after the =".
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*.*?\s([\w\-]+)\((.*)$")
-_OPERAND_RE = re.compile(r"%?([\w.\-]+)")
-
-
-def _split_computations(hlo_text):
-    """Split an HLO module dump into {computation_name: [instruction lines]}.
-
-    Computation headers sit at column 0 and end with `{`: terse style is
-    `region_0.574_spmd {` / `ENTRY main.1234_spmd {`, verbose style is
-    `%fused (p: f32[2]) -> f32[2] {`. Instruction lines are indented and
-    contain `=`, which the header pattern excludes."""
-    comps = {}
-    name, lines = None, []
-    header = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\b[^=]*{\s*$")
-    for line in hlo_text.splitlines():
-        if name is None:
-            m = header.match(line)
-            if m:
-                name, lines = m.group(1), []
-        elif line.startswith("}"):
-            comps[name] = lines
-            name = None
-        else:
-            lines.append(line)
-    return comps
-
-
-def overlap_verdict(hlo_text):
-    """Structural check of the --gather_overlap schedule.
-
-    Locates every while-loop body in the partitioned module and, per body,
-    counts its all-gathers and how many of them sit ON THE PREFETCH SLOT:
-    their result reaches the body's ROOT tuple (the carry for the next
-    iteration) through nothing but layout/dtype plumbing (_TRIVIAL_OPS).
-    Use-site gathers — what the plain ZeRO-3 scan has — are consumed by a
-    convolution/dot/fusion before any carry, so they never qualify.
-
-    Returns {gathers_in_scan_body, prefetch_slot_gathers,
-    per_iteration_gather_count: {body: count}} — the `--json` overlap
-    verdict the tier-1 suite asserts on (gather count unchanged between
-    off and on; prefetch-slot gathers appear only under on)."""
-    comps = _split_computations(hlo_text)
-    # first-occurrence order = program order of the while ops: the forward
-    # scan's body comes before the backward's, so consumers can key on the
-    # first entry for the fwd-schedule invariants
-    bodies = list(dict.fromkeys(re.findall(r"body=%?([\w.\-]+)", hlo_text)))
-
-    per_body = {}
-    slot_by_body = {}
-    for body in bodies:
-        lines = comps.get(body)
-        if lines is None:
-            continue
-        instrs = {}   # name -> (op, [operand names])
-        root = None
-        for line in lines:
-            m = _INSTR_RE.match(line)
-            if not m:
-                continue
-            iname, op, rest = m.groups()
-            # operand names: %refs up to the closing paren of the operand
-            # list (metadata/attrs after it may hold %refs to computations)
-            depth, end = 1, len(rest)
-            for i, ch in enumerate(rest):
-                if ch == "(":
-                    depth += 1
-                elif ch == ")":
-                    depth -= 1
-                    if depth == 0:
-                        end = i
-                        break
-            instrs[iname] = (op, _OPERAND_RE.findall(rest[:end]))
-            if line.lstrip().startswith("ROOT"):
-                root = iname
-        gathers = {n for n, (op, _) in instrs.items()
-                   if op in ("all-gather", "all-gather-start")}
-        per_body[body] = len(gathers)
-        slot_by_body[body] = 0
-        if root is None or not gathers:
-            continue
-        on_slot = set()
-        seen = set()
-        frontier = [root]
-        while frontier:
-            n = frontier.pop()
-            if n in seen or n not in instrs:
-                continue
-            seen.add(n)
-            op, operands = instrs[n]
-            if op in ("all-gather", "all-gather-start"):
-                on_slot.add(n)
-                continue  # the gather IS the slot value; don't look past it
-            if n == root or op in _TRIVIAL_OPS:
-                frontier.extend(operands)
-        slot_by_body[body] = len(on_slot)
-
-    return {
-        "gathers_in_scan_body": sum(per_body.values()),
-        "prefetch_slot_gathers": sum(slot_by_body.values()),
-        "per_iteration_gather_count": per_body,
-        "prefetch_slot_by_body": slot_by_body,
-    }
-
-
-def gather_bytes(rows, dtype=None, min_numel=0):
-    """Total all-gather bytes, optionally filtered by dtype / operand size."""
-    return sum(r["bytes"] for r in rows
-               if r["op"] == "all-gather"
-               and (dtype is None or r["dtype"] == dtype)
-               and r["numel"] >= min_numel)
-
-
-def partitioned_hlo_text(cfg, max_iteration=10_000):
-    """AOT-lower the train step for `cfg` and return the HLO module text
-    captured right after the SPMD partitioner (see module docstring for why
-    that stage and not the final executable)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding
-
-    from vitax.models import build_model
-    from vitax.ops.attention import make_attention_impl
-    from vitax.parallel.mesh import batch_pspec, build_mesh
-    from vitax.train.loop import _token_sharding
-    from vitax.train.state import build_optimizer, make_train_state
-    from vitax.train.step import make_train_step
-
-    mesh = build_mesh(cfg)
-    model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh),
-                        token_sharding=_token_sharding(cfg, mesh))
-    tx, _ = build_optimizer(cfg, max_iteration=max_iteration)
-    state, sspecs, _ = make_train_state(cfg, model, tx, mesh,
-                                        jax.random.key(cfg.seed),
-                                        materialize=False)
-    step = make_train_step(cfg, model, tx, mesh, sspecs)
-    sh = NamedSharding(mesh, batch_pspec())
-    batch = {
-        "image": jax.ShapeDtypeStruct(
-            (cfg.batch_size, cfg.image_size, cfg.image_size, 3),
-            jnp.float32, sharding=sh),
-        "label": jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32,
-                                      sharding=sh),
-    }
-    dump_dir = tempfile.mkdtemp(prefix="comm_audit_hlo_")
-    try:
-        step.lower(state, batch, jax.random.key(cfg.seed + 1)).compile(
-            compiler_options={"xla_dump_to": dump_dir,
-                              "xla_dump_hlo_pass_re": ".*partitioning"})
-        dumps = glob.glob(os.path.join(dump_dir, "*after_spmd-partitioning*"))
-        preferred = [f for f in dumps if "train_step" in os.path.basename(f)]
-        if not preferred:  # fall back to the largest module (the step)
-            preferred = sorted(dumps, key=os.path.getsize)[-1:]
-        if not preferred:
-            if mesh.size == 1:
-                # single-device compile: the SPMD partitioner never runs, so
-                # there is no dump — and no collectives to audit either
-                return ""
-            raise RuntimeError(
-                f"no post-partitioning HLO dump appeared in {dump_dir}; "
-                "this XLA build may not honour per-compile xla_dump_to")
-        with open(preferred[0], encoding="utf-8") as f:
-            return f.read()
-    finally:
-        shutil.rmtree(dump_dir, ignore_errors=True)
+__all__ = [
+    "COLLECTIVE_RE", "DTYPE_BYTES", "collect_collectives", "summarize",
+    "gather_bytes", "overlap_verdict", "partitioned_hlo_text",
+    "audit_config", "format_report", "main",
+]
 
 
 def audit_config(cfg):

@@ -2,20 +2,33 @@
 # Lint gate: flake8 (settings in .flake8, max-line-length 120) over the
 # production tree — vitax/ (including the vitax/telemetry/ observability
 # subsystem), tests/, tools/ (including tools/metrics_report.py) and
-# bench.py. tests/test_lint.py runs this as a tier-1 guard when flake8 is
-# installed; CI images without flake8 get a clean skip here too.
+# bench.py — plus the vitax.analysis source lint and a fast subset of the
+# compiled-program invariant checks. tests/test_lint.py runs flake8 as a
+# tier-1 guard when flake8 is installed; CI images without flake8 get a
+# clean skip here too.
 set -u
 cd "$(dirname "$0")/.."
 
 # these subsystems and their tools must exist and stay inside the linted
 # tree (a rename that drops them out of coverage should fail loudly)
 for path in vitax/telemetry tools/metrics_report.py \
-            vitax/serve tools/serve_bench.py tests/test_serve.py; do
+            vitax/serve tools/serve_bench.py tests/test_serve.py \
+            vitax/analysis tools/check_invariants.py tests/test_analysis.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
     fi
 done
+
+# AST lint: stdlib-only, always runs (VTX1xx source findings)
+python -m vitax.analysis.ast_lint || exit 1
+
+# compiled-program invariants, fast arm subset (VTX-Rnnn; rules.FAST_ARMS —
+# one train arm exercising every train rule, plus the serve arm).
+# VITAX_LINT_SKIP_INVARIANTS=1 skips on boxes without the jax toolchain.
+if [ "${VITAX_LINT_SKIP_INVARIANTS:-0}" != "1" ]; then
+    python tools/check_invariants.py --arms zero3_overlap serve || exit 1
+fi
 
 if ! python -m flake8 --version >/dev/null 2>&1; then
     echo "lint: flake8 not installed; skipping (pip install flake8 to enable)"

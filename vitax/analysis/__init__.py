@@ -1,0 +1,26 @@
+"""vitax.analysis — static analysis of compiled SPMD programs and source.
+
+The correctness-tooling layer for the perf invariants landed so far: every
+win (bf16 collectives, overlapped ZeRO-3 gathers, host-side-only telemetry,
+zero-recompile serve buckets, buffer donation) is a property of the *lowered
+program*, not of any Python object a unit test can poke — so the only place
+they are checkable is a static pass over the partitioner's output (the GSPMD
+lineage: the partitioned module IS the real program).
+
+Three pieces:
+
+  hlo       terse-HLO / StableHLO-MLIR parsers: collectives with dtype/bytes,
+            while-body op inventories, input_output_alias donation info,
+            host-transfer ops, per-arg shardings (generalized from the parser
+            previously private to tools/comm_audit.py)
+  rules     declarative rule registry: each rule is (id, severity,
+            applies_to(config), check(program, config) -> findings); built-ins
+            cover host transfers, donation, collective dtype policy, gather
+            overlap structure, replicated large params, serve recompiles
+  ast_lint  AST pass over vitax/ source with VTX-coded findings (host syncs in
+            jit-traced code, unfenced timing, argless jax.devices(), mutable
+            default args); `# vtx: ignore[VTXnnn] <reason>` suppressions
+
+Entry points: `python -m vitax.analysis.ast_lint` (source lint) and
+`python tools/check_invariants.py` (program verifier, the CI gate).
+"""

@@ -1,0 +1,274 @@
+"""AST lint for vitax/ source: host-sync and portability bug patterns.
+
+The compiled-program rules in :mod:`vitax.analysis.rules` catch invariant
+violations *after* they reach the lowered HLO; this pass catches the Python
+idioms that put them there — a `jax.device_get` inside a scanned block, a
+`float()` on a traced value, an argless `jax.devices()` that pins library
+code to whatever backend initialized first.
+
+Finding codes (Error Prone style: stable ids, CI-greppable):
+
+  VTX100  ERROR  bare `# vtx: ignore[...]` suppression with no reason text
+  VTX101  ERROR  jax.device_get / .block_until_ready() inside jit-traced
+                 modules (models/, ops/, parallel/, train/step.py) — forces a
+                 host sync or is a tracer error at trace time
+  VTX102  ERROR  float()/int()/.item() on a jnp/jax expression inside
+                 jit-traced modules — concretization error under jit
+  VTX103  WARN   two+ time.time()/perf_counter() calls bracketing a
+                 dispatch-like call with no fence (block_until_ready,
+                 device_get, np.asarray, .result(), .item()) in the same
+                 function — times dispatch, not execution
+  VTX104  ERROR  argless jax.devices() / jax.local_devices() in library code
+                 — platform-order dependent; use vitax.platform helpers or
+                 pass an explicit backend
+  VTX105  ERROR  mutable default argument (list/dict/set literal or call)
+
+Suppression: append `# vtx: ignore[VTX101] <reason>` to the offending line.
+Multiple codes: `# vtx: ignore[VTX101,VTX103] <reason>`. A suppression
+without a reason is itself an error (VTX100).
+
+Run: `python -m vitax.analysis.ast_lint [paths...] [--json]`
+(default path: the vitax/ package directory). Exit 1 on any ERROR finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+# Modules whose function bodies run under jit/scan tracing: host syncs and
+# concretizations there are either trace-time errors or silent step stalls.
+TRACED_SUBPATHS = (
+    os.path.join("vitax", "models") + os.sep,
+    os.path.join("vitax", "ops") + os.sep,
+    os.path.join("vitax", "parallel") + os.sep,
+    os.path.join("vitax", "train", "step.py"),
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*vtx:\s*ignore\[([A-Za-z0-9,\s]*)\]\s*(.*)")
+_TIMER_CALLS = {"time", "perf_counter", "monotonic"}
+_FENCE_TOKENS = ("block_until_ready", "device_get", "asarray", ".result(",
+                 ".item(", "np.array(")
+_DISPATCH_NAME_RE = re.compile(
+    r"(step|predict|compiled|jitted|forward|apply|_run)", re.IGNORECASE)
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str
+    severity: str  # "ERROR" | "WARN"
+    path: str
+    line: int
+    message: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} [{self.severity}] {self.message}"
+
+
+def _suppressions(source: str) -> Tuple[dict, List[Finding]]:
+    """Map line -> set of suppressed codes; bare suppressions are findings."""
+    by_line: dict = {}
+    bare: List[Finding] = []
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        reason = m.group(2).strip()
+        if not reason or not codes:
+            bare.append(Finding(
+                "VTX100", "ERROR", "", lineno,
+                "bare `# vtx: ignore[...]` — suppressions must carry a reason"))
+        else:
+            by_line[lineno] = codes
+    return by_line, bare
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('' if not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jax_expr(node: ast.AST) -> bool:
+    """Heuristic: does this expression syntactically involve jnp/jax?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "jax", "lax"):
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, traced: bool) -> None:
+        self.path = path
+        self.traced = traced
+        self.findings: List[Finding] = []
+        # (lineno, kind) events per function for the VTX103 timing check
+        self._func_stack: List[List[Tuple[int, str]]] = []
+
+    def _add(self, code: str, severity: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(code, severity, self.path, node.lineno, msg))
+
+    # -- function-scope bookkeeping -----------------------------------------
+    def _visit_func(self, node) -> None:
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and _dotted(default.func) in ("list", "dict", "set")):
+                self._add("VTX105", "ERROR", default,
+                          f"mutable default argument in `{node.name}()`")
+        self._func_stack.append([])
+        self.generic_visit(node)
+        events = self._func_stack.pop()
+        self._check_timing(node, events)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _check_timing(self, func, events: List[Tuple[int, str]]) -> None:
+        timers = [ln for ln, kind in events if kind == "timer"]
+        dispatches = [ln for ln, kind in events if kind == "dispatch"]
+        fences = [ln for ln, kind in events if kind == "fence"]
+        if len(timers) < 2 or not dispatches:
+            return
+        for d in dispatches:
+            before = [t for t in timers if t <= d]
+            after = [t for t in timers if t > d]
+            if before and after:
+                span = (before[-1], after[0])
+                if not any(span[0] <= f <= span[1] for f in fences):
+                    self._add(
+                        "VTX103", "WARN", func,
+                        f"`{func.name}()` wraps a dispatch-like call (line {d}) "
+                        "in timers with no fence — async dispatch means this "
+                        "times submission, not execution")
+                    return  # one finding per function is enough
+
+    # -- per-call checks ----------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        # `short` must survive chained calls (`jnp.sum(x).block_until_ready()`)
+        # where the dotted chain doesn't resolve to a plain name
+        if isinstance(node.func, ast.Attribute):
+            short = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            short = node.func.id
+        else:
+            short = ""
+
+        if self._func_stack:
+            events = self._func_stack[-1]
+            if name.startswith("time.") and short in _TIMER_CALLS:
+                events.append((node.lineno, "timer"))
+            elif short in ("block_until_ready", "device_get", "asarray",
+                           "result", "item", "array"):
+                events.append((node.lineno, "fence"))
+            elif _DISPATCH_NAME_RE.search(short or ""):
+                events.append((node.lineno, "dispatch"))
+
+        if short in ("devices", "local_devices") and name.startswith("jax.") \
+                and not node.args and not node.keywords:
+            self._add("VTX104", "ERROR", node,
+                      f"argless `{name}()` in library code — platform-order "
+                      "dependent; use vitax.platform helpers or pass a backend")
+
+        if self.traced:
+            if name == "jax.device_get" or short == "block_until_ready":
+                self._add("VTX101", "ERROR", node,
+                          f"`{name or short}` in jit-traced module — host sync "
+                          "inside the step program")
+            elif short in ("float", "int") and name in ("float", "int") \
+                    and node.args and _is_jax_expr(node.args[0]):
+                self._add("VTX102", "ERROR", node,
+                          f"`{short}()` on a jax expression in a jit-traced "
+                          "module — concretization error under jit")
+            elif short == "item" and isinstance(node.func, ast.Attribute) \
+                    and _is_jax_expr(node.func.value):
+                self._add("VTX102", "ERROR", node,
+                          "`.item()` on a jax expression in a jit-traced "
+                          "module — concretization error under jit")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str) -> List[Finding]:
+    """Lint one file's source text; returns surviving findings."""
+    traced = any(sub in path for sub in TRACED_SUBPATHS)
+    suppressed, bare = _suppressions(source)
+    for f in bare:
+        f.path = path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("VTX100", "ERROR", path, e.lineno or 1,
+                        f"syntax error: {e.msg}")]
+    visitor = _Visitor(path, traced)
+    visitor.visit(tree)
+    out = list(bare)
+    for f in visitor.findings:
+        if f.code in suppressed.get(f.line, ()):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+def lint_paths(paths: Iterable[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        findings.extend(_lint_file(os.path.join(dirpath, fn)))
+        else:
+            findings.extend(_lint_file(path))
+    return findings
+
+
+def _lint_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m vitax.analysis.ast_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the vitax/ package)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    findings = lint_paths(paths)
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if not findings:
+            print("ast_lint: clean")
+    return 1 if any(f.severity == "ERROR" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,509 @@
+"""Parsers over lowered/partitioned XLA programs.
+
+Generalized from the terse-HLO parser that grew inside tools/comm_audit.py
+(PRs 2-3) into the shared module every program-invariant rule builds on
+(vitax/analysis/rules.py, tools/check_invariants.py; comm_audit now imports
+from here).
+
+Two program artifacts, two parsers:
+
+- the **post-`spmd-partitioning` HLO text** (captured via a per-compile
+  `xla_dump_to`): collectives with dtype/shape/bytes, while-loop bodies and
+  their op inventories, the prefetch-slot overlap verdict, host-transfer ops,
+  and the module-header `input_output_alias` donation map. This stage — not
+  the final executable — is the backend-independent ground truth: XLA:CPU's
+  float normalization rewrites every bf16 collective as f32-wrapped-in-
+  converts in the final module, so the final CPU HLO can never show a bf16
+  gather no matter what the program asked for.
+
+- the **StableHLO MLIR text** (`lowered.as_text()`): per-argument shardings
+  (`mhlo.sharding`) and donation (`tf.aliasing_output`) straight off the
+  `@main` signature — available without compiling, and the only artifact
+  that still names which arguments are which.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
+import shutil
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+# `= bf16[2,32,128]{...} all-gather(` — dtype, shape, op from a partitioned-HLO
+# instruction line. `-start` variants cover async collectives; `-done` halves
+# carry no shape of their own and are skipped.
+COLLECTIVE_RE = re.compile(
+    r"= (\w+)\[([\d,]*)\][^ ]* "
+    r"((?:all-gather|all-reduce|reduce-scatter|all-to-all|collective-permute)"
+    r"(?:-start)?)\(")
+
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f16": 2, "bf16": 2, "s16": 2, "u16": 2,
+    "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8,
+}
+
+
+def collect_collectives(hlo_text: str) -> List[dict]:
+    """Parse a partitioned-HLO module into aggregated collective rows.
+
+    Returns a list of dicts {op, dtype, shape, count, numel, bytes} where
+    `bytes` is count * output-shape bytes. Output-shape bytes is the honest
+    per-step proxy for wire traffic: an all-gather's output is the gathered
+    tensor every participant materializes, an all-reduce/reduce-scatter's
+    output is what the reduction moves. (Exact wire bytes carry an extra
+    (n-1)/n ring factor that is identical across policies and so cancels in
+    every ratio this parser is used for.)
+    """
+    rows = collections.Counter()
+    for m in COLLECTIVE_RE.finditer(hlo_text):
+        dtype, shape_s, op = m.groups()
+        shape = tuple(int(d) for d in shape_s.split(",") if d)
+        rows[(op.replace("-start", ""), dtype, shape)] += 1
+    out = []
+    for (op, dtype, shape), count in sorted(rows.items()):
+        numel = 1
+        for d in shape:
+            numel *= d
+        out.append({
+            "op": op, "dtype": dtype, "shape": list(shape), "count": count,
+            "numel": numel,
+            "bytes": count * numel * DTYPE_BYTES.get(dtype, 4),
+        })
+    return out
+
+
+def summarize(rows: List[dict]) -> dict:
+    """Totals per op kind, split by element type."""
+    totals: dict = {}
+    for r in rows:
+        slot = totals.setdefault(r["op"], {"count": 0, "bytes": 0, "by_dtype": {}})
+        slot["count"] += r["count"]
+        slot["bytes"] += r["bytes"]
+        d = slot["by_dtype"].setdefault(r["dtype"], {"count": 0, "bytes": 0})
+        d["count"] += r["count"]
+        d["bytes"] += r["bytes"]
+    return totals
+
+
+def gather_bytes(rows: List[dict], dtype: Optional[str] = None,
+                 min_numel: int = 0) -> int:
+    """Total all-gather bytes, optionally filtered by dtype / operand size."""
+    return sum(r["bytes"] for r in rows
+               if r["op"] == "all-gather"
+               and (dtype is None or r["dtype"] == dtype)
+               and r["numel"] >= min_numel)
+
+
+def reduce_bytes(rows: List[dict], dtype: Optional[str] = None,
+                 min_numel: int = 0) -> int:
+    """Total reduce-scatter + all-reduce bytes, same filters as gather_bytes."""
+    return sum(r["bytes"] for r in rows
+               if r["op"] in ("reduce-scatter", "all-reduce")
+               and (dtype is None or r["dtype"] == dtype)
+               and r["numel"] >= min_numel)
+
+
+# ops a value may pass through on its way to the while body's ROOT tuple and
+# still count as "sitting on the carry": layout/dtype plumbing, not compute.
+# A gather whose result reaches ROOT only through these feeds the next
+# iteration's prefetch slot; a gather consumed by a dot/fusion first is a
+# use-site gather.
+TRIVIAL_OPS = frozenset({
+    "copy", "convert", "bitcast", "bitcast-convert", "reshape", "transpose",
+    "get-tuple-element", "tuple", "optimization-barrier", "all-gather-done",
+})
+
+# `  ROOT name = type op(a, b), attrs...` — name, op, operand list of one
+# instruction line. Handles both dump styles: the verbose one (`%name = f32[2]
+# add(%a, %b)`) and the terse one XLA emits for pass dumps (`add.3 = f32[2]
+# add(p.1, p.2)`); the type may itself be a parenthesised tuple, so the op is
+# "the first bare word directly followed by ( after the =".
+INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*.*?\s([\w\-]+)\((.*)$")
+_OPERAND_RE = re.compile(r"%?([\w.\-]+)")
+
+
+def split_computations(hlo_text: str) -> Dict[str, List[str]]:
+    """Split an HLO module dump into {computation_name: [instruction lines]}.
+
+    Computation headers sit at column 0 and end with `{`: terse style is
+    `region_0.574_spmd {` / `ENTRY main.1234_spmd {`, verbose style is
+    `%fused (p: f32[2]) -> f32[2] {`. Instruction lines are indented and
+    contain `=`, which the header pattern excludes."""
+    comps: Dict[str, List[str]] = {}
+    name, lines = None, []
+    header = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\b[^=]*{\s*$")
+    for line in hlo_text.splitlines():
+        if name is None:
+            m = header.match(line)
+            if m:
+                name, lines = m.group(1), []
+        elif line.startswith("}"):
+            comps[name] = lines
+            name = None
+        else:
+            lines.append(line)
+    return comps
+
+
+def while_bodies(hlo_text: str) -> List[str]:
+    """Names of every while-loop body computation, in program order.
+
+    First-occurrence order = program order of the while ops: the forward
+    scan's body comes before the backward's, so consumers can key on the
+    first entry for forward-schedule invariants."""
+    return list(dict.fromkeys(re.findall(r"body=%?([\w.\-]+)", hlo_text)))
+
+
+def parse_instructions(lines: List[str]) -> Tuple[Dict[str, Tuple[str, List[str]]], Optional[str]]:
+    """Parse one computation's instruction lines into
+    ({name: (op, [operand names])}, root_name)."""
+    instrs: Dict[str, Tuple[str, List[str]]] = {}
+    root = None
+    for line in lines:
+        m = INSTR_RE.match(line)
+        if not m:
+            continue
+        iname, op, rest = m.groups()
+        # operand names: %refs up to the closing paren of the operand
+        # list (metadata/attrs after it may hold %refs to computations)
+        depth, end = 1, len(rest)
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        instrs[iname] = (op, _OPERAND_RE.findall(rest[:end]))
+        if line.lstrip().startswith("ROOT"):
+            root = iname
+    return instrs, root
+
+
+def while_body_op_inventory(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Per while-loop body: {op name: count} over its instructions — the
+    cheap structural fingerprint of what a scan iteration executes."""
+    comps = split_computations(hlo_text)
+    out: Dict[str, Dict[str, int]] = {}
+    for body in while_bodies(hlo_text):
+        lines = comps.get(body)
+        if lines is None:
+            continue
+        instrs, _ = parse_instructions(lines)
+        counter: collections.Counter = collections.Counter(
+            op for op, _ in instrs.values())
+        out[body] = dict(counter)
+    return out
+
+
+def overlap_verdict(hlo_text: str) -> dict:
+    """Structural check of the --gather_overlap schedule.
+
+    Locates every while-loop body in the partitioned module and, per body,
+    counts its all-gathers and how many of them sit ON THE PREFETCH SLOT:
+    their result reaches the body's ROOT tuple (the carry for the next
+    iteration) through nothing but layout/dtype plumbing (TRIVIAL_OPS).
+    Use-site gathers — what the plain ZeRO-3 scan has — are consumed by a
+    convolution/dot/fusion before any carry, so they never qualify.
+
+    Returns {gathers_in_scan_body, prefetch_slot_gathers,
+    per_iteration_gather_count: {body: count}, prefetch_slot_by_body} — the
+    `--json` overlap verdict the tier-1 suite asserts on (gather count
+    unchanged between off and on; prefetch-slot gathers appear only under
+    on)."""
+    comps = split_computations(hlo_text)
+    bodies = while_bodies(hlo_text)
+
+    per_body = {}
+    slot_by_body = {}
+    for body in bodies:
+        lines = comps.get(body)
+        if lines is None:
+            continue
+        instrs, root = parse_instructions(lines)
+        gathers = {n for n, (op, _) in instrs.items()
+                   if op in ("all-gather", "all-gather-start")}
+        per_body[body] = len(gathers)
+        slot_by_body[body] = 0
+        if root is None or not gathers:
+            continue
+        on_slot = set()
+        seen = set()
+        frontier = [root]
+        while frontier:
+            n = frontier.pop()
+            if n in seen or n not in instrs:
+                continue
+            seen.add(n)
+            op, operands = instrs[n]
+            if op in ("all-gather", "all-gather-start"):
+                on_slot.add(n)
+                continue  # the gather IS the slot value; don't look past it
+            if n == root or op in TRIVIAL_OPS:
+                frontier.extend(operands)
+        slot_by_body[body] = len(on_slot)
+
+    return {
+        "gathers_in_scan_body": sum(per_body.values()),
+        "prefetch_slot_gathers": sum(slot_by_body.values()),
+        "per_iteration_gather_count": per_body,
+        "prefetch_slot_by_body": slot_by_body,
+    }
+
+
+# --- host transfers ---------------------------------------------------------
+
+# custom-call targets that move data to (or synchronize with) the host: the
+# Python callback family (io_callback / pure_callback / jax.debug.print all
+# lower to these) on CPU/GPU; outfeed/infeed are the TPU-side carriers.
+_HOST_CALLBACK_TARGET_RE = re.compile(
+    r'custom_call_target="([^"]*callback[^"]*)"')
+_HOST_OP_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|\S+)\s+(outfeed|infeed|send|send-done|recv|recv-done)\(")
+_MLIR_HOST_RE = re.compile(
+    r"stablehlo\.(outfeed|infeed|send|recv)\b|"
+    r"stablehlo\.custom_call\s+@(\S*callback\S*)\(")
+
+
+def host_transfer_ops(hlo_text: str) -> List[dict]:
+    """Every host-transfer op in a partitioned-HLO module: outfeed / infeed /
+    send / recv instructions and custom-calls into the host-callback family.
+
+    Returns [{op, detail, line}] where `line` is the stripped instruction
+    text (truncated) for the finding message."""
+    out = []
+    for i, line in enumerate(hlo_text.splitlines(), 1):
+        m = _HOST_OP_RE.search(line)
+        if m:
+            out.append({"op": m.group(1), "detail": m.group(1),
+                        "line": line.strip()[:160]})
+            continue
+        m = _HOST_CALLBACK_TARGET_RE.search(line)
+        if m:
+            out.append({"op": "custom-call", "detail": m.group(1),
+                        "line": line.strip()[:160]})
+    return out
+
+
+def mlir_host_transfer_ops(mlir_text: str) -> List[dict]:
+    """Host-transfer ops in a StableHLO module (the pre-compile view — works
+    on single-device programs the partitioner never touches)."""
+    out = []
+    for line in mlir_text.splitlines():
+        m = _MLIR_HOST_RE.search(line)
+        if m:
+            op = m.group(1) or "custom_call"
+            out.append({"op": op, "detail": m.group(2) or m.group(1),
+                        "line": line.strip()[:160]})
+    return out
+
+
+# --- donation (input_output_alias) ------------------------------------------
+
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(may-alias|must-alias)\)")
+
+
+def input_output_aliases(hlo_text: str) -> List[dict]:
+    """Parse the module-header `input_output_alias={ {out}: (param, {idx},
+    kind), ... }` donation map from a partitioned-HLO dump.
+
+    Returns [{output_index, parameter, kind}] — one entry per aliased
+    (donated and actually reused) buffer. An empty list under donate_argnums
+    means XLA dropped every donation (shape/dtype mismatch or a backend that
+    refuses aliasing) — exactly the regression the donation rule exists to
+    catch."""
+    header = hlo_text.splitlines()[0] if hlo_text else ""
+    key = "input_output_alias={"
+    start = header.find(key)
+    if start < 0:
+        return []
+    # the alias map nests braces ({ {0}: (0, {}, may-alias), ... }): scan to
+    # the balancing close instead of regexing across nesting
+    i = start + len(key)
+    depth, j = 1, i
+    while j < len(header) and depth:
+        if header[j] == "{":
+            depth += 1
+        elif header[j] == "}":
+            depth -= 1
+        j += 1
+    out = []
+    for om in _ALIAS_ENTRY_RE.finditer(header[i:j - 1]):
+        out.append({
+            "output_index": tuple(int(x) for x in om.group(1).split(",") if x.strip()),
+            "parameter": int(om.group(2)),
+            "kind": om.group(3),
+        })
+    return out
+
+
+# --- MLIR @main argument table ----------------------------------------------
+
+_MLIR_TYPE_RE = re.compile(r"tensor<([x\d]*?)(?:x)?([a-z]+\d+|i1)>")
+_MLIR_SHARDING_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
+_MLIR_DONOR_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+
+_MLIR_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1,
+}
+
+
+def mlir_main_args(mlir_text: str) -> List[dict]:
+    """Argument table of the StableHLO `@main` signature.
+
+    Returns [{index, dtype, shape, numel, bytes, sharding, donated_to}] in
+    argument order. `sharding` is the raw OpSharding string ("{replicated}",
+    "{devices=[1,8]<=[8]}", ...) or None when unannotated; `donated_to` is
+    the flat output index the buffer is donated to (`tf.aliasing_output`)
+    or None for non-donated args. This is the only artifact where donation
+    and sharding are still attached to *arguments* rather than anonymous
+    parameter numbers."""
+    m = re.search(r"func\.func\s+public\s+@main\s*\((.*?)\)\s*->", mlir_text,
+                  re.DOTALL)
+    if not m:
+        return []
+    # split the signature on argument boundaries: everything between
+    # `%argN:` and the next `%argM:` (type + attr dict) belongs to arg N —
+    # sidesteps brace-matching the attr dict, whose sharding strings nest
+    # braces inside quotes
+    parts = re.split(r"%arg(\d+)\s*:", m.group(1))
+    out = []
+    for i in range(1, len(parts) - 1, 2):
+        idx = int(parts[i])
+        body = parts[i + 1]
+        tm = _MLIR_TYPE_RE.search(body)
+        shape: Tuple[int, ...] = ()
+        dtype = "?"
+        if tm:
+            shape = tuple(int(d) for d in tm.group(1).split("x") if d)
+            dtype = tm.group(2)
+        sm = _MLIR_SHARDING_RE.search(body)
+        dm = _MLIR_DONOR_RE.search(body)
+        numel = 1
+        for d in shape:
+            numel *= d
+        out.append({
+            "index": idx, "dtype": dtype, "shape": list(shape),
+            "numel": numel,
+            "bytes": numel * _MLIR_DTYPE_BYTES.get(dtype, 4),
+            "sharding": sm.group(1) if sm else None,
+            "donated_to": int(dm.group(1)) if dm else None,
+        })
+    return out
+
+
+def sharding_is_replicated(sharding: Optional[str]) -> bool:
+    """Whether an OpSharding string places the value on every device whole.
+
+    None (unannotated) counts as replicated: GSPMD's default for an
+    unconstrained input is replication, which is precisely the silent
+    regression the large-param rule hunts."""
+    if sharding is None:
+        return True
+    s = sharding.strip()
+    if "replicated" in s or "maximal" in s:
+        return "devices=" not in s
+    # "{devices=[1,1,8]<=[8] last_tile_dim_replicate}" with ALL non-trailing
+    # tile dims 1 is also full replication
+    m = re.search(r"devices=\[([\d,]+)\]", s)
+    if m:
+        dims = [int(d) for d in m.group(1).split(",")]
+        if "last_tile_dim_replicate" in s:
+            dims = dims[:-1]
+        return all(d == 1 for d in dims)
+    return False
+
+
+# --- program capture --------------------------------------------------------
+
+
+def capture_partitioned(lowered, module_hint: str = "train_step") -> str:
+    """Compile a `jax.stages.Lowered` with a per-compile dump and return the
+    HLO module text right after the SPMD partitioner.
+
+    Why this stage and not the final executable: backend simplification
+    passes may rewrite collective element types after SPMD partitioning.
+    XLA:CPU's float normalization in particular rewrites every bf16
+    collective as an f32 collective wrapped in converts, so the final CPU
+    HLO can never show a bf16 gather no matter what the program asked for.
+    The post-`spmd-partitioning` module is the backend-independent ground
+    truth for what dtype each collective moves.
+
+    Returns "" for single-device programs (the partitioner never runs, so
+    there is no dump — and no collectives to audit either)."""
+    dump_dir = tempfile.mkdtemp(prefix="vitax_analysis_hlo_")
+    try:
+        lowered.compile(
+            compiler_options={"xla_dump_to": dump_dir,
+                              "xla_dump_hlo_pass_re": ".*partitioning"})
+        dumps = glob.glob(os.path.join(dump_dir, "*after_spmd-partitioning*"))
+        preferred = [f for f in dumps if module_hint in os.path.basename(f)]
+        if not preferred:  # fall back to the largest module (the step)
+            preferred = sorted(dumps, key=os.path.getsize)[-1:]
+        if not preferred:
+            import jax
+            if len(jax.devices()) == 1:  # vtx: ignore[VTX104] analysis tool probing whatever backend is live
+                return ""
+            raise RuntimeError(
+                f"no post-partitioning HLO dump appeared in {dump_dir}; "
+                "this XLA build may not honour per-compile xla_dump_to")
+        with open(preferred[0], encoding="utf-8") as f:
+            return f.read()
+    finally:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+
+
+def lower_train_step(cfg, max_iteration: int = 10_000, donate: bool = True):
+    """AOT-lower the train step for `cfg` on the current backend.
+
+    Returns (lowered, n_state_leaves): the `jax.stages.Lowered` step and the
+    number of TrainState leaves (the donation rule's expected aliased-buffer
+    count). `donate=False` builds the same program without donate_argnums —
+    the deliberately-broken arm the donation rule's negative test compiles.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from vitax.models import build_model
+    from vitax.ops.attention import make_attention_impl
+    from vitax.parallel.mesh import batch_pspec, build_mesh
+    from vitax.train.loop import _token_sharding
+    from vitax.train.state import build_optimizer, make_train_state
+    from vitax.train.step import make_train_step
+
+    mesh = build_mesh(cfg)
+    model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh),
+                        token_sharding=_token_sharding(cfg, mesh))
+    tx, _ = build_optimizer(cfg, max_iteration=max_iteration)
+    state, sspecs, _ = make_train_state(cfg, model, tx, mesh,
+                                        jax.random.key(cfg.seed),
+                                        materialize=False)
+    step = make_train_step(cfg, model, tx, mesh, sspecs, donate=donate)
+    sh = NamedSharding(mesh, batch_pspec())
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.image_size, cfg.image_size, 3),
+            jnp.float32, sharding=sh),
+        "label": jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32,
+                                      sharding=sh),
+    }
+    lowered = step.lower(state, batch, jax.random.key(cfg.seed + 1))
+    n_state_leaves = len(jax.tree_util.tree_leaves(state))
+    return lowered, n_state_leaves
+
+
+def partitioned_hlo_text(cfg, max_iteration: int = 10_000) -> str:
+    """AOT-lower the train step for `cfg` and return the post-partitioning
+    HLO module text (the tools/comm_audit.py entry point, kept here so the
+    audit and the invariant verifier share one lowering path)."""
+    lowered, _ = lower_train_step(cfg, max_iteration=max_iteration)
+    return capture_partitioned(lowered)

@@ -1,0 +1,420 @@
+"""Declarative SPMD program-invariant rules.
+
+Every perf win in this repo is a property of the *lowered program* — bf16
+collectives (PR 2), prefetch-slot gathers (PR 3), host-side-only telemetry
+(PR 4), zero-recompile serve buckets (PR 5), buffer donation — so a future
+refactor can silently regress any of them without a unit test noticing. Each
+rule here turns one such folklore invariant into a checkable gate (the Error
+Prone model: bug patterns as compile-time checks), run over the programs
+`build_train_program` / `build_serve_program` lower across the parallelism
+arms (tools/check_invariants.py is the CLI/CI entry).
+
+A rule is declarative data: (id, severity, kinds, applies_to(config),
+check(program, config) -> findings). `applies_to` filters by configuration
+(e.g. the collective-dtype rule only binds when the bf16 comm-cast policy is
+active); `check` parses the program artifacts via vitax.analysis.hlo. Rules
+never mutate the program; findings carry enough detail for a CI log to be
+actionable without rerunning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from vitax.analysis import hlo
+from vitax.config import Config
+
+SEVERITIES = ("ERROR", "WARN")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation in one program."""
+    rule: str
+    severity: str
+    arm: str
+    message: str
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Program:
+    """One lowered program plus the artifacts the rules parse.
+
+    kind "train": `mlir` (lowered StableHLO, always present) and
+    `partitioned_hlo` (post-SPMD-partitioning dump; "" on single-device
+    meshes where the partitioner never runs). kind "serve": a warmed-up
+    InferenceEngine (the AOT bucket invariants are runtime properties of
+    the executable set, not of any one module's text)."""
+    kind: str                     # "train" | "serve"
+    arm: str
+    config: Config
+    mlir: str = ""
+    partitioned_hlo: str = ""
+    mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
+    n_state_leaves: int = 0
+    engine: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str                       # stable VTX-Rnnn code (CI contract)
+    name: str                     # kebab-case human handle
+    severity: str                 # ERROR fails CI; WARN is advisory
+    kinds: Tuple[str, ...]        # program kinds the rule reads
+    description: str
+    applies_to: Callable[[Config], bool]
+    check: Callable[[Program, Config], List[Finding]]
+
+    def applicable(self, program: Program) -> bool:
+        return (program.kind in self.kinds
+                and self.applies_to(program.config))
+
+
+RULES: List[Rule] = []
+
+
+def rule(id: str, name: str, severity: str, kinds: Tuple[str, ...],
+         description: str, applies_to: Callable[[Config], bool] = lambda cfg: True):
+    """Register a check function as a Rule."""
+    assert severity in SEVERITIES, severity
+
+    def wrap(fn: Callable[[Program, Config], List[Finding]]) -> Rule:
+        r = Rule(id=id, name=name, severity=severity, kinds=tuple(kinds),
+                 description=description, applies_to=applies_to, check=fn)
+        assert all(existing.id != id for existing in RULES), f"duplicate {id}"
+        RULES.append(r)
+        return r
+
+    return wrap
+
+
+def _finding(r: Rule, program: Program, message: str, **details) -> Finding:
+    return Finding(rule=r.id, severity=r.severity, arm=program.arm,
+                   message=message, details=details)
+
+
+def large_param_threshold_bytes(cfg: Config) -> int:
+    """Size above which a replicated parameter is a sharding regression: one
+    f32 block matmul matrix (embed_dim^2 * 4). Everything the fsdp axis is
+    meant to shard is at least this big; everything legitimately replicated
+    (LN scales, cls token, small pos embeds, the step counter) is far
+    smaller."""
+    return cfg.embed_dim * cfg.embed_dim * 4
+
+
+# --- built-in rules ---------------------------------------------------------
+
+
+@rule("VTX-R001", "no-host-transfer-in-step", "ERROR", ("train",),
+      "the compiled train step must not move data to the host: no outfeed/"
+      "infeed/send/recv, no host-callback custom-calls (a stray jax.debug."
+      "print or io_callback serializes every step on a device->host sync; "
+      "telemetry is host-side by contract, PR 4)")
+def check_no_host_transfer(program: Program, cfg: Config) -> List[Finding]:
+    r = NO_HOST_TRANSFER
+    ops = (hlo.host_transfer_ops(program.partitioned_hlo)
+           if program.partitioned_hlo
+           else hlo.mlir_host_transfer_ops(program.mlir))
+    return [
+        _finding(r, program,
+                 f"host transfer in compiled step: {o['op']} ({o['detail']})",
+                 instruction=o["line"])
+        for o in ops
+    ]
+
+
+@rule("VTX-R002", "donation-honored", "ERROR", ("train",),
+      "donate_argnums on the train state must survive to the executable: "
+      "every state leaf aliased input->output (a dropped donation doubles "
+      "the optimizer-state footprint silently)")
+def check_donation(program: Program, cfg: Config) -> List[Finding]:
+    r = DONATION_HONORED
+    out: List[Finding] = []
+    args = hlo.mlir_main_args(program.mlir)
+    donated = [a for a in args if a["donated_to"] is not None]
+    if len(donated) < program.n_state_leaves:
+        out.append(_finding(
+            r, program,
+            f"only {len(donated)} of {program.n_state_leaves} state buffers "
+            f"are marked donated in the lowered program (donate_argnums "
+            f"dropped or not set)",
+            donated=len(donated), expected=program.n_state_leaves))
+    if program.partitioned_hlo:
+        aliases = hlo.input_output_aliases(program.partitioned_hlo)
+        if len(aliases) < program.n_state_leaves:
+            out.append(_finding(
+                r, program,
+                f"compiler honored only {len(aliases)} of "
+                f"{program.n_state_leaves} donations (input_output_alias "
+                f"header) — XLA refused aliasing for the rest",
+                aliased=len(aliases), expected=program.n_state_leaves))
+    return out
+
+
+@rule("VTX-R003", "collective-dtype-policy", "ERROR", ("train",),
+      "under the bf16 comm-precision policy every block-sized param "
+      "all-gather must move bf16 (and block-sized grad reductions bf16 when "
+      "--grad_reduce_dtype bfloat16): an f32 collective doubles wire bytes "
+      "— the PR 2 win regressing silently",
+      applies_to=lambda cfg: cfg.comm_cast_active)
+def check_collective_dtype(program: Program, cfg: Config) -> List[Finding]:
+    r = COLLECTIVE_DTYPE
+    if not program.partitioned_hlo:
+        return []  # single-device program: no collectives to police
+    out: List[Finding] = []
+    rows = hlo.collect_collectives(program.partitioned_hlo)
+    block_numel = cfg.embed_dim * cfg.embed_dim  # smallest block matmul param
+    for row in rows:
+        if (row["op"] == "all-gather" and row["dtype"] == "f32"
+                and row["numel"] >= block_numel):
+            out.append(_finding(
+                r, program,
+                f"f32 block-param all-gather under the bf16 gather policy: "
+                f"{row['count']}x {row['shape']} ({row['bytes']:,} B/step)",
+                collective=row))
+        if (cfg.grad_reduce_dtype == "bfloat16"
+                and row["op"] in ("reduce-scatter", "all-reduce")
+                and row["dtype"] == "f32" and row["numel"] >= block_numel):
+            out.append(_finding(
+                r, program,
+                f"f32 block-sized grad {row['op']} under --grad_reduce_dtype "
+                f"bfloat16: {row['count']}x {row['shape']} "
+                f"({row['bytes']:,} B/step)",
+                collective=row))
+    return out
+
+
+def _overlap_requested(cfg: Config) -> bool:
+    """Config-only restriction of sharding.gather_overlap_active: `on`, or
+    `auto` with every config-side precondition met (the mesh-side fsdp>1
+    condition is re-checked in the rule body against program.mesh_shape)."""
+    mode = getattr(cfg, "gather_overlap", "auto")
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return (cfg.reshard_after_forward and not cfg.run_without_fsdp
+            and cfg.scan_blocks and cfg.grad_ckpt
+            and cfg.remat_policy == "none_saveable"
+            and getattr(cfg, "pp_size", 1) == 1)
+
+
+@rule("VTX-R004", "gather-overlap-structure", "ERROR", ("train",),
+      "with --gather_overlap active, every per-iteration forward all-gather "
+      "must sit on the scan carry's prefetch slot (reach the while body ROOT "
+      "through layout plumbing only) — a use-site gather means the double "
+      "buffering silently degraded to the serial schedule (PR 3)",
+      applies_to=_overlap_requested)
+def check_gather_overlap(program: Program, cfg: Config) -> List[Finding]:
+    r = GATHER_OVERLAP
+    if program.mesh_shape.get("fsdp", 1) <= 1:
+        return []  # nothing to overlap on an unsharded fsdp axis
+    verdict = hlo.overlap_verdict(program.partitioned_hlo)
+    per_body = verdict["per_iteration_gather_count"]
+    if not per_body:
+        return [_finding(r, program,
+                         "no while-loop body with gathers found — the "
+                         "overlap schedule did not lower to a scanned "
+                         "program at all", verdict=verdict)]
+    # the first while body in program order is the forward scan
+    fwd = next(iter(per_body))
+    n_gathers = per_body[fwd]
+    on_slot = verdict["prefetch_slot_by_body"].get(fwd, 0)
+    if n_gathers == 0:
+        return [_finding(r, program,
+                         f"forward scan body {fwd} issues no per-iteration "
+                         "gathers — ZeRO-3 per-block gathers were hoisted "
+                         "or lost", verdict=verdict)]
+    if on_slot != n_gathers:
+        return [_finding(
+            r, program,
+            f"{n_gathers - on_slot} of {n_gathers} forward in-loop gathers "
+            f"are use-site gathers (not on the prefetch slot): the overlap "
+            f"schedule regressed to serial gather-then-compute",
+            verdict=verdict)]
+    return []
+
+
+@rule("VTX-R005", "no-replicated-large-params", "ERROR", ("train",),
+      "under fsdp arms no state buffer above one block-matrix in size may "
+      "lower fully replicated: a replicated 10B tree is an instant HBM OOM "
+      "at flagship scale and a silent memory regression at any scale",
+      applies_to=lambda cfg: not cfg.run_without_fsdp and cfg.fsdp_size != 1)
+def check_no_replicated_large_params(program: Program, cfg: Config) -> List[Finding]:
+    r = NO_REPLICATED_LARGE
+    if program.mesh_shape.get("fsdp", 1) <= 1:
+        return []  # the resolved mesh has no sharding capacity to demand
+    threshold = large_param_threshold_bytes(cfg)
+    out: List[Finding] = []
+    for a in hlo.mlir_main_args(program.mlir):
+        if a["donated_to"] is None:
+            continue  # donated args are exactly the state buffers
+        if a["bytes"] >= threshold and hlo.sharding_is_replicated(a["sharding"]):
+            out.append(_finding(
+                r, program,
+                f"state buffer arg{a['index']} ({a['dtype']}{a['shape']}, "
+                f"{a['bytes']:,} B) lowers fully replicated under an fsdp "
+                f"mesh (sharding={a['sharding']})",
+                arg=a, threshold_bytes=threshold))
+    return out
+
+
+@rule("VTX-R006", "serve-no-recompile", "ERROR", ("serve",),
+      "steady-state serving must never compile: after warmup, compile count "
+      "== bucket count, mixed-size traffic reuses the AOT executables, and "
+      "a bucket executable rejects shapes it was not compiled for (PR 5)")
+def check_serve_no_recompile(program: Program, cfg: Config) -> List[Finding]:
+    r = SERVE_NO_RECOMPILE
+    import numpy as np
+    eng = program.engine
+    out: List[Finding] = []
+    expected = len(eng.buckets)
+    if eng.compile_count != expected:
+        out.append(_finding(
+            r, program,
+            f"compile_count {eng.compile_count} != bucket count {expected} "
+            f"after warmup",
+            compile_count=eng.compile_count, buckets=list(eng.buckets)))
+    s = cfg.image_size
+    before = eng.compile_count
+    # mixed-size traffic: exact smallest, exact largest, and one off-bucket
+    # size that must pad rather than compile
+    sizes = sorted({1, eng.buckets[-1], min(3, eng.buckets[-1])})
+    for n in sizes:
+        eng.predict(np.zeros((n, s, s, 3), np.uint8))
+    if eng.compile_count != before:
+        out.append(_finding(
+            r, program,
+            f"serving traffic of sizes {sizes} triggered "
+            f"{eng.compile_count - before} recompile(s)",
+            sizes=sizes, compiles=eng.compile_count - before))
+    # the AOT executables must reject unseen shapes instead of silently
+    # recompiling for them
+    b0 = eng.buckets[0]
+    try:
+        import jax
+        bad = np.zeros((b0, s + 1, s + 1, 3), np.uint8)
+        eng._compiled[b0](
+            eng.params, jax.device_put(bad, eng._batch_shardings[b0]))
+        out.append(_finding(
+            r, program,
+            f"bucket-{b0} executable accepted an unseen input shape "
+            f"{bad.shape} — recompiles are not structurally impossible"))
+    except Exception:
+        pass  # rejection is the invariant
+    return out
+
+
+NO_HOST_TRANSFER = RULES[0]
+DONATION_HONORED = RULES[1]
+COLLECTIVE_DTYPE = RULES[2]
+GATHER_OVERLAP = RULES[3]
+NO_REPLICATED_LARGE = RULES[4]
+SERVE_NO_RECOMPILE = RULES[5]
+
+
+def rules_for(program: Program) -> List[Rule]:
+    return [r for r in RULES if r.applicable(program)]
+
+
+def run_rules(program: Program) -> Tuple[List[str], List[Finding]]:
+    """Run every applicable rule over one program.
+
+    Returns (rule ids run, findings). An empty findings list from a rule
+    means the invariant holds in this program."""
+    ran, findings = [], []
+    for r in rules_for(program):
+        ran.append(r.id)
+        findings.extend(r.check(program, program.config))
+    return ran, findings
+
+
+# --- program builders (the parallelism arms the CI gate lowers) -------------
+
+# Small geometry, CPU-loweable on the 8-virtual-device mesh. batch_size 64
+# keeps B*N above the GSPMD partial-dot threshold (see
+# tests/test_gather_overlap.py geometry note) so the arms exercise the real
+# weight-gather strategies the rules police.
+BASE_GEOMETRY = dict(
+    image_size=16, patch_size=8, embed_dim=32, num_heads=2, num_blocks=2,
+    num_classes=4, batch_size=64, warmup_steps=2,
+)
+
+# arm name -> Config overrides on top of BASE_GEOMETRY. dtype defaults to
+# bfloat16, so the bf16 comm-cast policy (and with it VTX-R003) is active on
+# every fsdp arm; "dp" pins float32 as the no-policy baseline.
+TRAIN_ARMS: Dict[str, dict] = {
+    "dp": dict(run_without_fsdp=True, dtype="float32"),
+    "zero2": dict(reshard_after_forward=False),
+    "zero3": dict(gather_overlap="off"),
+    "zero3_overlap": dict(gather_overlap="on"),
+    "accum": dict(batch_size=128, grad_accum_steps=2),
+    "moe": dict(moe_experts=4, gather_overlap="off"),
+}
+
+SERVE_ARM = "serve"
+ALL_ARMS = tuple(TRAIN_ARMS) + (SERVE_ARM,)
+# the lint.sh / pre-push subset: one train arm covering R001-R005 (the
+# overlap arm applies every train rule) plus the serve arm for R006
+FAST_ARMS = ("zero3_overlap", SERVE_ARM)
+
+
+def arm_config(arm: str, **overrides) -> Config:
+    kw = dict(BASE_GEOMETRY)
+    if arm == SERVE_ARM:
+        kw.update(serve_max_batch=4)
+    else:
+        kw.update(TRAIN_ARMS[arm])
+    kw.update(overrides)
+    return Config(**kw).validate()
+
+
+def build_train_program(cfg: Config, arm: str = "custom",
+                        donate: bool = True) -> Program:
+    """Lower the train step for `cfg` and capture both rule artifacts."""
+    from vitax.parallel.mesh import build_mesh
+    lowered, n_state_leaves = hlo.lower_train_step(cfg, donate=donate)
+    mesh = build_mesh(cfg)
+    return Program(
+        kind="train", arm=arm, config=cfg,
+        mlir=lowered.as_text(),
+        partitioned_hlo=hlo.capture_partitioned(lowered),
+        mesh_shape=dict(mesh.shape),
+        n_state_leaves=n_state_leaves,
+    )
+
+
+def build_serve_program(cfg: Config, arm: str = SERVE_ARM) -> Program:
+    """Build and warm an InferenceEngine over randomly-initialized sharded
+    params (the AOT bucket invariants do not depend on the weights)."""
+    import jax
+    import jax.numpy as jnp
+
+    from vitax.parallel.mesh import build_mesh
+    from vitax.parallel.sharding import init_sharded_params
+    from vitax.serve.engine import InferenceEngine, _build_model
+
+    mesh = build_mesh(cfg)
+    model = _build_model(cfg, mesh)
+    sample_b = mesh.shape["dp"] * mesh.shape["fsdp"]
+    sample = jnp.zeros((sample_b, cfg.image_size, cfg.image_size, 3),
+                       jnp.float32)
+    params, _ = init_sharded_params(
+        lambda rng: model.init(rng, sample, True),
+        jax.random.key(cfg.seed), cfg, mesh)
+    engine = InferenceEngine(cfg, mesh, model, params)
+    engine.warmup()
+    return Program(kind="serve", arm=arm, config=cfg,
+                   mesh_shape=dict(mesh.shape), engine=engine)
+
+
+def build_program(arm: str, **overrides) -> Program:
+    cfg = arm_config(arm, **overrides)
+    if arm == SERVE_ARM:
+        return build_serve_program(cfg)
+    return build_train_program(cfg, arm=arm)

@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.parallel.mesh import BATCH_AXES, shard_map
+from vitax.platform import backend_platform
 
 MAX_SEQ_IN_VMEM = 2048  # (N, N) f32 scores: 16 MB at 2048 — VMEM ceiling
 
@@ -50,7 +51,7 @@ def _interpret() -> bool:
     import os
     if os.environ.get("VITAX_FORCE_MOSAIC"):
         return False
-    return jax.devices()[0].platform != "tpu"
+    return backend_platform() != "tpu"
 
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
@@ -798,7 +799,7 @@ def _tpu_dropout_kernel(cfg, n: int, force: bool = False,
     kernels are disabled / off-TPU without force."""
     if not cfg.use_flash_attention or cfg.att_dropout <= 0.0:
         return None
-    if not force and jax.devices()[0].platform != "tpu":
+    if not force and backend_platform() != "tpu":
         return None
     h = local_heads or cfg.num_heads
     dh = cfg.embed_dim // cfg.num_heads
@@ -947,7 +948,7 @@ def _tpu_kernel(cfg, n: int, force: bool = False, local_heads: int = 0):
     global count."""
     if not cfg.use_flash_attention:
         return None, None
-    if not force and jax.devices()[0].platform != "tpu":
+    if not force and backend_platform() != "tpu":
         return None, None
     h = local_heads or cfg.num_heads
     dh = cfg.embed_dim // cfg.num_heads

@@ -127,7 +127,7 @@ def build_mesh(cfg: Config, devices: Optional[Sequence[jax.Device]] = None) -> M
     """Build the 6-axis mesh. Device order follows jax.devices(), which on TPU
     reflects physical torus coordinates — keeping the fastest-varying axis
     ("sp", then "tp") on the closest ICI neighbors."""
-    devices = list(devices) if devices is not None else jax.devices()
+    devices = list(devices) if devices is not None else jax.devices()  # vtx: ignore[VTX104] mesh wants real devices
     shape = resolve_mesh_shape(cfg, len(devices))
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, MESH_AXES)

@@ -83,6 +83,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.config import Config
 from vitax.parallel.mesh import BATCH_AXES, optimization_barrier, shard_map
+from vitax.platform import backend_platform
 
 
 def _gather_over(x, spec: P, axis_name: str):
@@ -135,7 +136,7 @@ def make_pp_forward(cfg: Config, model, mesh: Mesh, block_specs=None):
     # directly in the pipeline body over the in-scope "sp" axis.
     tp_auto = mesh.shape["tp"] > 1
     if (tp_auto and cfg.dtype == "bfloat16"
-            and jax.devices()[0].platform == "cpu"):
+            and backend_platform() == "cpu"):
         # a warning here would be followed by a native XLA abort the user
         # can't connect back to it (ADVICE r4) — fail loudly instead
         raise ValueError(

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from vitax.parallel.mesh import BATCH_AXES, axis_size, shard_map
+from vitax.platform import backend_platform
 
 
 def _dense_block(q, k, v, scale: float):
@@ -121,7 +122,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     False -> dense jnp, None -> Pallas exactly on TPU.
     """
     if use_kernel is None:
-        use_kernel = jax.devices()[0].platform == "tpu"
+        use_kernel = backend_platform() == "tpu"
     block_fn = _kernel_block if use_kernel else _dense_block
     spec = P(BATCH_AXES, axis_name, "tp", None)
 
@@ -211,7 +212,7 @@ def make_ring_dropout(mesh: Mesh, rate: float, axis_name: str = "sp",
     folded over the batch/tp shard position but NOT over sp — sp shards
     must agree on the global mask for the ring-equals-dense property."""
     if use_kernel is None:
-        use_kernel = jax.devices()[0].platform == "tpu"
+        use_kernel = backend_platform() == "tpu"
     block_fn = _kernel_block_drop if use_kernel else _dense_block_drop
     spec = P(BATCH_AXES, axis_name, "tp", None)
 
@@ -247,7 +248,7 @@ def make_ring_dropout_pp(rate: float, axis_name: str = "sp",
     in forward and backward (no cross-shard mask agreement is needed; the
     global-offset coordinates still decorrelate the kv blocks)."""
     if use_kernel is None:
-        use_kernel = jax.devices()[0].platform == "tpu"
+        use_kernel = backend_platform() == "tpu"
     block_fn = _kernel_block_drop if use_kernel else _dense_block_drop
 
     def ring_dropout_local(q, k, v, seed):
@@ -275,7 +276,7 @@ def make_ring_attention_pp(axis_name: str = "sp",
     the einsums over the tp-global head dim, whereas a Pallas kernel cannot
     be auto-partitioned."""
     if use_kernel is None:
-        use_kernel = jax.devices()[0].platform == "tpu"
+        use_kernel = backend_platform() == "tpu"
     block_fn = _kernel_block if (use_kernel and not with_tp) else _dense_block
 
     def ring_attention_local(q: jax.Array, k: jax.Array,

@@ -20,3 +20,18 @@ def force_cpu_if_requested() -> None:
     """Honor JAX_PLATFORMS=cpu even when a TPU plugin would claim the backend."""
     if is_cpu_forced():
         jax.config.update("jax_platforms", "cpu")
+
+
+def backend_platform() -> str:
+    """Platform name ("cpu"/"tpu"/"gpu") of the default backend.
+
+    The sanctioned single query point: library code should call this (or
+    `device_kind()`) instead of `jax.devices()[0].platform`, so that backend
+    selection stays a process-level decision made here.
+    """
+    return jax.devices()[0].platform  # vtx: ignore[VTX104] sanctioned single query point
+
+
+def device_kind() -> str:
+    """Hardware kind of the default backend's first device (e.g. "TPU v4")."""
+    return jax.devices()[0].device_kind  # vtx: ignore[VTX104] sanctioned single query point

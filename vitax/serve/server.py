@@ -33,6 +33,7 @@ from typing import Optional
 from vitax.config import Config
 from vitax.serve.engine import InferenceEngine
 from vitax.serve.batcher import DynamicBatcher
+from vitax.platform import device_kind
 from vitax.utils.logging import master_print
 
 # acceptance contract of a serve_request record: tools/serve_bench.py and
@@ -129,7 +130,7 @@ def build_serve_recorder(cfg: Config):
               f"({e}); serve telemetry disabled", file=sys.stderr, flush=True)
         return None
     return Recorder(cfg, sinks, jax.device_count(),
-                    jax.devices()[0].device_kind, rank=0)
+                    device_kind(), rank=0)
 
 
 class ServeContext:

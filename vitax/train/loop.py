@@ -18,7 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from vitax import distributed
+from vitax import distributed, platform
 from vitax.checkpoint import restore_state, save_state
 from vitax.config import Config
 from vitax.data import build_datasets
@@ -137,7 +137,7 @@ def train(cfg: Config) -> TrainState:
     # --- telemetry (vitax/telemetry/): all host-side — the compiled step
     # program and its dispatch cadence are identical with telemetry off ---
     recorder = build_recorder(cfg, jax.device_count(),
-                              jax.devices()[0].device_kind,
+                              platform.device_kind(),
                               rank=jax.process_index())
     if recorder is not None:
         master_print(f"telemetry: JSONL step records -> {cfg.metrics_dir} "

@@ -150,10 +150,14 @@ def make_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     state_specs: PyTree,
+    donate: bool = True,
 ) -> Callable[[TrainState, Dict[str, jax.Array], jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step: (state, batch, rng) -> (state, metrics).
 
     - `donate` on state: params/opt-state buffers are reused in place.
+      `donate=False` exists for the program-invariant verifier only
+      (vitax/analysis/rules.py donation-honored rule compiles it as the
+      deliberately-broken negative arm); production callers always donate.
     - ZeRO-2 mode (`--no_reshard_after_forward`): params are constrained to a
       fully-gathered (over "fsdp") layout at the top of the step, so the
       all-gather happens once and the gathered weights stay live through
@@ -362,7 +366,7 @@ def make_train_step(
         train_step,
         in_shardings=(state_shardings, batch_sharding, rng_sharding),
         out_shardings=(state_shardings, None),
-        donate_argnums=(0,),
+        donate_argnums=(0,) if donate else (),
     )
 
     # Work counts for the telemetry throughput records (images/s, tokens/s).

@@ -30,7 +30,7 @@ def memory_stats_dict(device=None) -> dict:
     Uses PJRT memory_stats when the backend provides them (TPU does); degrades
     gracefully where stats are unavailable.
     """
-    device = device or jax.local_devices()[0]
+    device = device or jax.local_devices()[0]  # vtx: ignore[VTX104] host-local memory stats
     try:
         stats = device.memory_stats()
     except Exception:
