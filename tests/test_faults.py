@@ -1,0 +1,585 @@
+"""Failure-reaction tests (PR 7): deterministic fault injection, watchdog
+escalation, checkpoint hardening (torn-dir skip, save retry, restore
+fallback), the supervisor's restart/backoff/crash-loop logic, and the
+kill-and-resume equivalence pins.
+
+Unit arms run tier-1 (fake children, injectable clocks/exits, in-process
+trainings); the subprocess drills through tools/supervise.py are `slow`.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from vitax import faults
+from vitax.checkpoint.orbax_io import (committed_epochs, epoch_ckpt_path,
+                                       is_committed_checkpoint, latest_epoch,
+                                       load_resume_step,
+                                       restore_state_with_fallback,
+                                       save_state, wait_until_finished)
+from vitax.config import Config
+from vitax.supervise import (EXIT_BUDGET, Supervisor, ensure_auto_resume,
+                             main as supervise_main, scrape_flag)
+from vitax.telemetry.watchdog import EXIT_HANG, Watchdog
+
+from tests.test_checkpoint import abstract_of, make_state, tiny_cfg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No fault plan may leak across tests (the registry is module-global)."""
+    yield
+    faults.uninstall()
+
+
+def _wait_until(cond, timeout_s=5.0, poll_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll_s)
+    return cond()
+
+
+# --- fault-plan parsing + registry determinism ------------------------------
+
+def test_parse_plan_accepts_all_three_shapes():
+    one = '{"site": "step", "action": "crash", "at": 6}'
+    as_list = f"[{one}]"
+    wrapped = f'{{"faults": [{one}]}}'
+    for text in (one, as_list, wrapped):
+        plan = faults.parse_plan(text)
+        assert len(plan.specs) == 1
+        assert plan.specs[0].site == "step" and plan.specs[0].at == 6
+        assert plan.specs[0].exit_code == faults.DEFAULT_CRASH_EXIT_CODE
+
+
+@pytest.mark.parametrize("bad", [
+    "not json at all",
+    "42",
+    '{"site": "nowhere", "action": "crash"}',
+    '{"site": "step", "action": "explode"}',
+    '{"site": "step"}',
+    '{"site": "step", "action": "crash", "at": 0}',
+    '{"site": "step", "action": "crash", "times": 0}',
+    '{"site": "step", "action": "crash", "typo_key": 1}',
+    "[]",
+])
+def test_parse_plan_rejects_bad_plans(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+def test_config_validates_fault_plan_and_hang_action():
+    # a bad plan fails at startup (config validation), not at step N
+    with pytest.raises(AssertionError):
+        Config(fault_plan="not json").validate()
+    with pytest.raises(AssertionError):
+        Config(hang_action="restart").validate()
+    cfg = Config(fault_plan='{"site": "step", "action": "hang"}',
+                 hang_action="checkpoint_exit").validate()
+    assert cfg.hang_action == "checkpoint_exit"
+
+
+def test_hooks_are_noops_with_no_plan():
+    faults.uninstall()
+    assert not faults.active()
+    for _ in range(3):  # would raise/hang/exit if anything were armed
+        faults.fire("step", index=1)
+        faults.fire("ckpt_write")
+        faults.fire("loader")
+
+
+def test_oserror_fires_deterministically_in_at_times_window():
+    faults.install('{"site": "ckpt_write", "action": "oserror", '
+                   '"at": 2, "times": 2}')
+    fired = []
+    for call in range(1, 6):  # internal per-site counter: calls 2,3 fire
+        try:
+            faults.fire("ckpt_write")
+            fired.append(False)
+        except OSError:
+            fired.append(True)
+    assert fired == [False, True, True, False, False]
+
+
+def test_explicit_index_overrides_counter_and_reporter_sees_payload():
+    faults.install('{"site": "step", "action": "oserror", "at": 7}')
+    events = []
+    faults.set_reporter(events.append)
+    faults.fire("step", index=3)  # not at 7: silent
+    with pytest.raises(OSError):
+        faults.fire("step", index=7)
+    with pytest.raises(OSError):
+        faults.fire("step", index=7)  # explicit index: re-fires, by design
+    assert [e["index"] for e in events] == [7, 7]
+    assert events[0]["site"] == "step" and events[0]["action"] == "oserror"
+
+
+def test_install_from_config_env_fallback(monkeypatch):
+    plan = '{"site": "loader", "action": "stall", "seconds": 0}'
+    monkeypatch.setenv(faults.ENV_VAR, plan)
+    installed = faults.install_from_config(Config())  # no --fault_plan
+    assert installed is not None and installed.specs[0].site == "loader"
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert faults.install_from_config(Config()) is None  # nothing set: disarm
+    assert not faults.active()
+
+
+# --- step program identity: the failure-reaction layer is host-side only ----
+
+def test_fault_and_hang_flags_trace_identical_step_program(devices8):
+    """--fault_plan / --hang_action are host-side machinery: the lowered
+    train-step program must be bit-identical with them set or unset (same
+    acceptance pin telemetry carries in test_telemetry.py)."""
+    from tests.test_train_smoke import build_train_objects, random_batch
+
+    def lowered(cfg):
+        mesh, state, step_fn, _ = build_train_objects(cfg)
+        batch = random_batch(cfg, mesh)
+        return step_fn.lower(state, batch, jax.random.key(0)).as_text()
+
+    off = lowered(tiny_cfg())
+    plan = '{"site": "step", "action": "hang", "at": 999999}'
+    faults.install(plan)  # armed registry during trace, for good measure
+    on = lowered(tiny_cfg(fault_plan=plan, hang_action="checkpoint_exit",
+                          hang_timeout_s=300.0))
+    assert off == on
+
+
+# --- watchdog escalation (unit: fake hard_exit, no process dies) ------------
+
+def test_watchdog_escalates_once_with_pinned_exit_code():
+    escalations, exits = [], []
+    wd = Watchdog(timeout_s=0.1, poll_s=0.02, action="checkpoint_exit",
+                  hard_deadline_s=30.0, on_escalate=escalations.append,
+                  hard_exit=exits.append).start()
+    try:
+        assert _wait_until(wd.escalation_requested)
+        assert len(escalations) == 1
+        assert escalations[0]["exit_code"] == EXIT_HANG == 42
+        # a pet after escalation re-arms the DUMP but never the escalation
+        wd.pet()
+        assert wd.escalation_requested()
+        assert _wait_until(lambda: wd.fire_count >= 2)  # second stall dumps...
+        assert len(escalations) == 1  # ...but escalates no second time
+        assert exits == []  # deadline far away: no hard exit
+    finally:
+        wd.stop()
+
+
+def test_watchdog_hard_exits_when_loop_never_polls():
+    exits = []
+    wd = Watchdog(timeout_s=0.1, poll_s=0.02, action="checkpoint_exit",
+                  hard_deadline_s=0.15, hard_exit=exits.append).start()
+    try:
+        assert _wait_until(lambda: exits == [EXIT_HANG])
+        time.sleep(0.1)
+        assert exits == [EXIT_HANG]  # fired once, then disarmed
+    finally:
+        wd.stop()
+
+
+def test_watchdog_acknowledge_extends_the_hard_deadline():
+    exits = []
+    wd = Watchdog(timeout_s=0.1, poll_s=0.02, action="checkpoint_exit",
+                  hard_deadline_s=0.3, hard_exit=exits.append).start()
+    try:
+        assert _wait_until(wd.escalation_requested)
+        # the "loop" keeps acknowledging (emergency save in progress): the
+        # deadline keeps moving and the hard exit must not fire
+        for _ in range(10):
+            wd.acknowledge_escalation()
+            time.sleep(0.05)
+        assert exits == []
+        assert _wait_until(lambda: exits == [EXIT_HANG], timeout_s=2.0)
+    finally:
+        wd.stop()
+
+
+def test_watchdog_dump_action_never_escalates():
+    wd = Watchdog(timeout_s=0.1, poll_s=0.02, action="dump",
+                  hard_exit=lambda code: pytest.fail("hard exit under dump"),
+                  ).start()
+    try:
+        assert _wait_until(lambda: wd.fire_count >= 1)
+        assert not wd.escalation_requested()
+    finally:
+        wd.stop()
+
+
+# --- checkpoint hardening ---------------------------------------------------
+
+def _tiny_tree():
+    return {"w": np.arange(8, dtype=np.float32)}
+
+
+def test_latest_epoch_skips_torn_checkpoint_dir(tmp_path):
+    ckpt = str(tmp_path)
+    save_state(ckpt, 1, _tiny_tree(), wait=True)
+    save_state(ckpt, 2, _tiny_tree(), wait=True)
+    assert is_committed_checkpoint(epoch_ckpt_path(ckpt, 2))
+    # hand-tear epoch_3 the way a crash mid-async-write does: the dir and a
+    # data file exist, the commit marker does not
+    torn = epoch_ckpt_path(ckpt, 3)
+    os.makedirs(os.path.join(torn, "w"))
+    with open(os.path.join(torn, "w", "shard_0"), "wb") as f:
+        f.write(b"\x00" * 64)
+    assert not is_committed_checkpoint(torn)
+    assert committed_epochs(ckpt) == [1, 2]
+    assert latest_epoch(ckpt) == 2  # auto-resume can never select epoch 3
+
+
+def test_save_state_retries_transient_write_failures(tmp_path, monkeypatch):
+    monkeypatch.setenv("VITAX_SAVE_RETRY_BACKOFF_S", "0.01")
+    # 2 injected failures < 3 attempts: the save must succeed on the third
+    faults.install('{"site": "ckpt_write", "action": "oserror", '
+                   '"at": 1, "times": 2}')
+    save_state(str(tmp_path), 1, _tiny_tree(), wait=True)
+    assert latest_epoch(str(tmp_path)) == 1
+
+    # failures >= the retry budget: the save must surface the OSError
+    faults.install('{"site": "ckpt_write", "action": "oserror", '
+                   '"at": 1, "times": 99}')
+    with pytest.raises(OSError):
+        save_state(str(tmp_path), 2, _tiny_tree(), wait=True)
+    faults.uninstall()
+    assert latest_epoch(str(tmp_path)) == 1
+
+
+def test_restore_falls_back_to_previous_committed_epoch(devices8, tmp_path):
+    cfg = tiny_cfg(ckpt_dir=str(tmp_path))
+    mesh, state, sspecs = make_state(cfg)
+    bumped = state.replace(params=jax.tree.map(lambda x: x * 2.0,
+                                               state.params))
+    save_state(cfg.ckpt_dir, 1, state, wait=True)
+    save_state(cfg.ckpt_dir, 2, bumped, wait=True)
+    wait_until_finished()
+    # corrupt epoch_2 BEHIND its commit marker: array data gone, marker kept
+    ep2 = epoch_ckpt_path(cfg.ckpt_dir, 2)
+    for name in os.listdir(ep2):
+        if name not in ("_CHECKPOINT_METADATA", "commit_success.txt"):
+            path = os.path.join(ep2, name)
+            if os.path.isdir(path):
+                import shutil
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+    assert is_committed_checkpoint(ep2)  # looks fine from the outside...
+
+    restored, epoch = restore_state_with_fallback(
+        cfg.ckpt_dir, 2, abstract_of(state, mesh, sspecs))
+    assert epoch == 1  # ...but restore drops, loudly, to the previous epoch
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- loader worker-error surfacing ------------------------------------------
+
+def test_loader_worker_exception_carries_worker_traceback(devices8):
+    from vitax.data.loader import (LoaderWorkerError, ShardedLoader,
+                                   ShardedSampler)
+    from vitax.parallel.mesh import build_mesh
+
+    class BrokenDataset:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, idx):
+            raise ValueError(f"boom-sample-{idx}")
+
+    mesh = build_mesh(tiny_cfg())
+    sampler = ShardedSampler(32, 16, shuffle=False, seed=0,
+                             process_index=0, process_count=1)
+    loader = ShardedLoader(BrokenDataset(), sampler, mesh, num_workers=2)
+    try:
+        with pytest.raises(LoaderWorkerError) as err:
+            next(iter(loader.epoch(1)))
+        msg = str(err.value)
+        assert "boom-sample-" in msg
+        assert "worker traceback" in msg and "__getitem__" in msg
+        assert isinstance(err.value.__cause__, ValueError)
+    finally:
+        loader.close()
+
+
+# --- supervisor (unit: fake children, injected clock) -----------------------
+
+class _FakeChild:
+    """A 'process' whose exit code is known in advance; `delay_polls` makes
+    poll() return None that many times first (a still-running child)."""
+
+    def __init__(self, rc, delay_polls=0):
+        self.rc = rc
+        self.delay_polls = delay_polls
+        self.signals = []
+
+    def poll(self):
+        if self.delay_polls > 0:
+            self.delay_polls -= 1
+            return None
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        self.rc = 0  # a drained child exits cleanly
+        self.delay_polls = 0
+
+    def kill(self):
+        self.signals.append("KILL")
+        self.rc = -9
+        self.delay_polls = 0
+
+
+def _supervisor(tmp_path, rcs, progresses, **kw):
+    children = [_FakeChild(rc) for rc in rcs]
+    spawned = []
+    progress_it = iter(progresses)
+    sleeps = []
+    sup = Supervisor(
+        ["python", "train.py"], ckpt_dir=str(tmp_path),
+        metrics_dir=str(tmp_path),
+        spawn=lambda argv: spawned.append(argv) or children[len(spawned) - 1],
+        progress_fn=lambda: next(progress_it),
+        sleep=sleeps.append, **kw)
+    return sup, sleeps, children
+
+
+def test_supervisor_clean_child_needs_no_restart(tmp_path):
+    sup, sleeps, _ = _supervisor(tmp_path, [0], [(0, 0)])
+    assert sup.run() == 0
+    assert sup.restart_count == 0 and sleeps == []
+    assert not os.path.exists(os.path.join(str(tmp_path), "metrics.jsonl"))
+
+
+def test_supervisor_restarts_with_exponential_backoff(tmp_path):
+    # two crashes, each with checkpoint progress, then success
+    progresses = [(0, 0), (1, 0),   # run 1: before/after — epoch 1 landed
+                  (1, 0), (1, 3),   # run 2: a mid-epoch save advanced step
+                  (1, 3)]           # run 3 exits 0: no 'after' probe
+    sup, sleeps, _ = _supervisor(tmp_path, [13, EXIT_HANG, 0], progresses,
+                                 backoff_s=0.5, backoff_max_s=10.0)
+    assert sup.run() == 0
+    assert sup.restart_count == 2
+    assert sup.last_exit_code == 0
+    assert sleeps == [0.5, 1.0]  # capped exponential: 0.5 * 2^(n-1)
+    # forced auto-resume on the child command
+    assert sup.child_argv[-2:] == ["--resume_epoch", "-1"]
+    # restart telemetry landed in metrics.jsonl with the exit codes
+    lines = [json.loads(ln) for ln in
+             open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    assert [e["kind"] for e in lines] == ["restart", "restart"]
+    assert [e["exit_code"] for e in lines] == [13, EXIT_HANG]
+    assert all(e["schema"] == 1 and e["progress"] for e in lines)
+
+
+def test_supervisor_detects_crash_loop(tmp_path):
+    # dies repeatedly with a frozen checkpoint frontier: deterministic bug,
+    # not flaky infrastructure — give up with the distinct budget code
+    sup, sleeps, _ = _supervisor(
+        tmp_path, [13, 13, 13, 13], [(2, 0)] * 8,
+        crash_loop_tolerance=1, backoff_s=0.25, max_restarts=50)
+    assert sup.run() == EXIT_BUDGET
+    assert sup.restart_count == 1  # one restart burned, then the loop verdict
+    assert sleeps == [0.25]
+
+
+def test_supervisor_exhausts_restart_budget(tmp_path):
+    # always-progressing child that still keeps dying: budget bounds it
+    progresses = iter(((i, 0) for i in range(100)))
+    sup = Supervisor(["python", "train.py"], ckpt_dir=str(tmp_path),
+                     max_restarts=3, backoff_s=0.0, crash_loop_tolerance=99,
+                     spawn=lambda argv: _FakeChild(1),
+                     progress_fn=lambda: next(progresses),
+                     sleep=lambda s: None)
+    assert sup.run() == EXIT_BUDGET
+    assert sup.restart_count == 4  # 3 allowed restarts + the over-budget try
+    assert sup.last_exit_code == 1
+
+
+def test_supervisor_forwards_sigterm_once_and_passes_code_through(tmp_path):
+    child = _FakeChild(7, delay_polls=100)
+    sup = Supervisor(["python", "train.py"], ckpt_dir=str(tmp_path),
+                     spawn=lambda argv: child, progress_fn=lambda: (0, 0),
+                     sleep=lambda s: None, term_grace_s=30.0)
+    sup._term_requested = True  # as the SIGTERM handler would set it
+    rc = sup.run()
+    # the drained child's code passes through; no restart fights the scheduler
+    assert rc == 0 and sup.restart_count == 0
+    assert child.signals == [signal.SIGTERM]
+
+
+def test_ensure_auto_resume_rewrites_every_spelling():
+    assert ensure_auto_resume(["t.py"]) == ["t.py", "--resume_epoch", "-1"]
+    assert ensure_auto_resume(["t.py", "--resume_epoch", "4"]) == \
+        ["t.py", "--resume_epoch", "-1"]
+    assert ensure_auto_resume(["t.py", "--resume_epoch=4"]) == \
+        ["t.py", "--resume_epoch=-1"]
+    assert scrape_flag(["--ckpt_dir=/a", "--metrics_dir", "/b"],
+                       "--metrics_dir") == "/b"
+
+
+def test_supervise_cli_requires_child_command():
+    assert supervise_main([]) == 2
+    assert supervise_main(["--max_restarts", "2", "--"]) == 2
+
+
+def test_metrics_report_surfaces_restart_and_fault_events(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    records = [
+        {"schema": 1, "step": 1, "loss": 2.0, "sec_per_iter": 0.1},
+        {"schema": 1, "kind": "fault", "site": "step", "action": "hang"},
+        {"schema": 1, "kind": "hang_escalation", "exit_code": 42},
+        {"schema": 1, "kind": "restart", "exit_code": 42, "restart": 1},
+        {"schema": 1, "kind": "restart", "exit_code": 13, "restart": 2},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "metrics_report.py"),
+         str(path), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["restart_count"] == 2
+    assert summary["last_exit_code"] == 13
+    assert summary["fault_events"] == 1
+    assert summary["hang_escalations"] == 1
+
+
+# --- kill-and-resume equivalence: hang -> escalation -> auto-resume ---------
+
+def test_hang_escalation_checkpoint_exit_resume_equivalence(devices8,
+                                                            tmp_path):
+    """The full reaction chain, in process: an injected hang starves the
+    watchdog, --hang_action checkpoint_exit escalates, the loop commits an
+    emergency mid-epoch checkpoint and exits EXIT_HANG; auto-resume then
+    finishes the run to a state equal to an uninterrupted one."""
+    from vitax.train.loop import train
+
+    common = dict(
+        fake_data=True, num_epochs=2, steps_per_epoch=5, log_step_interval=10,
+        ckpt_epoch_interval=99, test_epoch_interval=99, num_workers=2,
+        eval_max_batches=1,
+    )
+    base = train(tiny_cfg(ckpt_dir=str(tmp_path / "base"), **common))
+    assert int(jax.device_get(base.step)) == 10
+
+    # global step 8 = epoch 2, third step: sleep 2s past a 1s watchdog
+    # (the watchdog arms at the first dispatch return, so compile time is
+    # outside the window; the consumer wakes at 2.0s, well inside the hard
+    # deadline of ~1.0..1.25 + 2.0)
+    hang_dir = str(tmp_path / "hang")
+    plan = ('{"site": "step", "action": "hang", "at": 8, "seconds": 2.0}')
+    with pytest.raises(SystemExit) as exc:
+        train(tiny_cfg(ckpt_dir=hang_dir, fault_plan=plan,
+                       hang_timeout_s=1.0, hang_action="checkpoint_exit",
+                       **common))
+    assert exc.value.code == EXIT_HANG == 42
+    assert latest_epoch(hang_dir) == 2  # emergency save committed
+    assert load_resume_step(hang_dir, 2) == 3  # ...mid-epoch, 3 steps done
+
+    # auto-resume (no fault plan) re-enters epoch 2 at step 4 and finishes
+    resumed = train(tiny_cfg(ckpt_dir=hang_dir, resume_epoch=-1, **common))
+    assert int(jax.device_get(resumed.step)) == 10
+    for a, b in zip(jax.tree.leaves(base.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+# --- subprocess drills through tools/supervise.py (slow) --------------------
+
+TINY_CHILD_FLAGS = [
+    "--fake_data", "--image_size", "16", "--patch_size", "8",
+    "--embed_dim", "32", "--num_heads", "2", "--num_blocks", "2",
+    "--num_classes", "4", "--batch_size", "16", "--dtype", "float32",
+    "--warmup_steps", "2", "--num_epochs", "2", "--steps_per_epoch", "5",
+    "--log_step_interval", "10", "--test_epoch_interval", "99",
+    "--num_workers", "2", "--eval_max_batches", "1",
+]
+
+
+def _run_sub(cmd, timeout=1500, **extra_env):
+    # VITAX_CKPT_SYNC: every save commits before returning, so "the child
+    # crashed N steps past an epoch boundary" deterministically implies the
+    # boundary checkpoint is durable (no race vs the background commit)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               VITAX_CKPT_SYNC="1", **extra_env)
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _final_params(ckpt_dir, devices8_cfg):
+    """Restore the epoch_2 checkpoint a subprocess run wrote, in this
+    process (Orbax array files are not byte-comparable across writes — the
+    restored values are)."""
+    mesh, state, sspecs = make_state(devices8_cfg)
+    from vitax.checkpoint import restore_state
+    restored = restore_state(str(ckpt_dir), 2,
+                             abstract_of(state, mesh, sspecs))
+    return jax.tree.leaves(restored.params)
+
+
+@pytest.mark.slow
+def test_supervised_crash_resume_bitwise_equivalence(devices8, tmp_path):
+    """THE acceptance pin: an uninterrupted 2-epoch run vs the same run
+    hard-crashed (os._exit 13) mid-epoch-2 under tools/supervise.py. The
+    supervisor restarts it, auto-resume picks up the committed epoch-1
+    checkpoint, and the final epoch-2 states are bitwise equal."""
+    base_dir = tmp_path / "base"
+    r = _run_sub([sys.executable, "run_vit_training.py", *TINY_CHILD_FLAGS,
+                  "--ckpt_epoch_interval", "1",
+                  "--ckpt_dir", str(base_dir)])
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    crash_dir = tmp_path / "crash"
+    metrics_dir = tmp_path / "metrics"
+    plan = '{"site": "step", "action": "crash", "at": 8, "exit_code": 13}'
+    r = _run_sub([sys.executable, os.path.join("tools", "supervise.py"),
+                  "--backoff_s", "0.1", "--",
+                  sys.executable, "run_vit_training.py", *TINY_CHILD_FLAGS,
+                  "--ckpt_epoch_interval", "1",
+                  "--ckpt_dir", str(crash_dir),
+                  "--metrics_dir", str(metrics_dir),
+                  "--fault_plan", plan])
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-3000:])
+    assert "injecting step:crash" in r.stderr  # the drill actually fired
+
+    cfg = tiny_cfg()
+    for a, b in zip(_final_params(base_dir, cfg),
+                    _final_params(crash_dir, cfg)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the restart landed in the child's own metrics stream with exit code 13
+    mr = _run_sub([sys.executable, os.path.join("tools", "metrics_report.py"),
+                   str(metrics_dir / "metrics.jsonl"), "--json"], timeout=60)
+    summary = json.loads(mr.stdout)
+    assert summary["restart_count"] >= 1
+    assert summary["last_exit_code"] == 13
+
+
+@pytest.mark.slow
+def test_supervisor_gives_up_on_crash_loop_nonzero(tmp_path):
+    """A child that crashes before any checkpoint can commit is a crash
+    loop: the supervisor must exit nonzero (EXIT_BUDGET), not restart
+    forever."""
+    plan = '{"site": "step", "action": "crash", "at": 1, "exit_code": 13}'
+    r = _run_sub([sys.executable, os.path.join("tools", "supervise.py"),
+                  "--crash_loop_tolerance", "0", "--backoff_s", "0.05", "--",
+                  sys.executable, "run_vit_training.py", *TINY_CHILD_FLAGS,
+                  "--fault_plan", plan,
+                  "--ckpt_epoch_interval", "99",
+                  "--ckpt_dir", str(tmp_path / "ckpt")])
+    assert r.returncode == EXIT_BUDGET == 3, (r.stdout[-2000:],
+                                              r.stderr[-3000:])
+    assert "CRASH LOOP" in r.stderr

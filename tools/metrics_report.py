@@ -88,7 +88,16 @@ def summarize(path: str) -> dict:
         "events": len(events),
         "corrupt_lines": corrupt,
         "hang_events": sum(1 for e in events if e.get("kind") == "hang"),
+        "fault_events": sum(1 for e in events if e.get("kind") == "fault"),
+        "hang_escalations": sum(1 for e in events
+                                if e.get("kind") == "hang_escalation"),
     }
+    # supervisor restarts (vitax/supervise.py appends these between child
+    # runs, so they interleave with the child's own records)
+    restarts = [e for e in events if e.get("kind") == "restart"]
+    summary["restart_count"] = len(restarts)
+    summary["last_exit_code"] = (restarts[-1].get("exit_code")
+                                 if restarts else None)
     evals = [e for e in events if e.get("kind") == "eval"]
     if evals:
         last = max(evals, key=lambda e: (e.get("epoch", 0), e.get("time", 0)))
@@ -135,6 +144,14 @@ def print_human(summary: dict) -> None:
           f"schema {summary['schema']}")
     if summary.get("hang_events"):
         print(f"  !! watchdog hang events: {summary['hang_events']}")
+    if summary.get("hang_escalations"):
+        print(f"  !! watchdog escalations (checkpoint+exit): "
+              f"{summary['hang_escalations']}")
+    if summary.get("fault_events"):
+        print(f"  injected faults fired: {summary['fault_events']}")
+    if summary.get("restart_count"):
+        print(f"  !! supervisor restarts: {summary['restart_count']} "
+              f"(last child exit code {summary['last_exit_code']})")
     ev = summary.get("eval_last")
     if ev:
         print(f"  eval (epoch {ev['epoch']}): top1 {ev['top1']:.4f}  "

@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Launcher shim: `python tools/supervise.py [flags] -- python
+run_vit_training.py ...` — see vitax/supervise.py for the restart loop,
+exit-code contract, and flags."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vitax.supervise import main  # noqa: E402  (sys.path fix must precede)
+
+if __name__ == "__main__":
+    sys.exit(main())

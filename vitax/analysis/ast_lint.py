@@ -22,6 +22,12 @@ Finding codes (Error Prone style: stable ids, CI-greppable):
                  — platform-order dependent; use vitax.platform helpers or
                  pass an explicit backend
   VTX105  ERROR  mutable default argument (list/dict/set literal or call)
+  VTX106  ERROR  broad `except:` / `except Exception:` / `except
+                 BaseException:` whose body only passes — swallows every
+                 error silently; in a fault-tolerant trainer a swallowed
+                 exception becomes an undiagnosable hang or wrong result
+                 (narrow excepts like OSError are fine; so is a broad
+                 except that logs, re-raises, or otherwise acts)
 
 Suppression: append `# vtx: ignore[VTX101] <reason>` to the offending line.
 Multiple codes: `# vtx: ignore[VTX101,VTX103] <reason>`. A suppression
@@ -160,6 +166,36 @@ class _Visitor(ast.NodeVisitor):
                         "in timers with no fence — async dispatch means this "
                         "times submission, not execution")
                     return  # one finding per function is enough
+
+    # -- exception-handler checks -------------------------------------------
+    @staticmethod
+    def _is_broad_exc(node: Optional[ast.AST]) -> bool:
+        """Does this except clause catch everything (bare / Exception /
+        BaseException, possibly inside a tuple)?"""
+        if node is None:  # bare `except:`
+            return True
+        if isinstance(node, ast.Tuple):
+            return any(_Visitor._is_broad_exc(e) for e in node.elts)
+        return (_dotted(node) or "").split(".")[-1] in (
+            "Exception", "BaseException")
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        def _noop(stmt: ast.stmt) -> bool:
+            # pass, `...`, or a bare string (comment-as-docstring)
+            return isinstance(stmt, ast.Pass) or (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and (stmt.value.value is Ellipsis
+                     or isinstance(stmt.value.value, str)))
+
+        body_is_noop = all(_noop(stmt) for stmt in node.body)
+        if body_is_noop and self._is_broad_exc(node.type):
+            caught = _dotted(node.type) if node.type is not None else ""
+            label = f"except {caught}" if caught else "bare except"
+            self._add("VTX106", "ERROR", node,
+                      f"`{label}` with a pass-only body swallows every error "
+                      "silently — catch a narrow type, or log/act on it")
+        self.generic_visit(node)
 
     # -- per-call checks ----------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:

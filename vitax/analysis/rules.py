@@ -305,8 +305,8 @@ def check_serve_no_recompile(program: Program, cfg: Config) -> List[Finding]:
             r, program,
             f"bucket-{b0} executable accepted an unseen input shape "
             f"{bad.shape} — recompiles are not structurally impossible"))
-    except Exception:
-        pass  # rejection is the invariant
+    except Exception:  # vtx: ignore[VTX106] rejection IS the pass condition of this probe
+        pass
     return out
 
 

@@ -1,7 +1,10 @@
 from vitax.checkpoint.orbax_io import (  # noqa: F401
+    committed_epochs,
     epoch_ckpt_path,
+    is_committed_checkpoint,
     latest_epoch,
     restore_state,
+    restore_state_with_fallback,
     save_state,
     wait_until_finished,
 )

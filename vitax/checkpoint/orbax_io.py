@@ -20,9 +20,18 @@ donate/overwrite the state buffers in the next train step) and commits the
 write in a background thread — at 10B, the serialize+write no longer stalls
 every rank (improving on the reference's synchronous xm.save,
 utils.py:24-34). Atomicity is Orbax's tmp-dir+rename commit; `latest_epoch`
-only matches finalized `epoch_<N>` directory names, so a crash mid-write can
-never be resumed from. Call `wait_until_finished()` (epoch end, exit) or pass
-`wait=True` (final epoch) to drain.
+additionally validates the commit marker Orbax writes at finalize
+(_CHECKPOINT_METADATA / commit_success.txt), so a torn `epoch_<N>/` left by
+a hard crash mid-write (or a non-atomic shared store, e.g. GCS fuse) can
+never be selected by `--resume_epoch -1`. Call `wait_until_finished()`
+(epoch end, exit) or pass `wait=True` (final epoch) to drain.
+
+Failure reaction (PR 7): `save_state` retries transient OSErrors with capped
+backoff before surfacing (VITAX_SAVE_RETRIES / VITAX_SAVE_RETRY_BACKOFF_S
+override the defaults), and `restore_state_with_fallback` drops — loudly —
+to the previous committed epoch when the newest one fails to restore, so an
+auto-resume is never wedged by one bad checkpoint. The `ckpt_write` fault
+hook (vitax/faults.py) fires once per write attempt to drill both paths.
 
 Single-file consolidation (consolidate_sharded_ckpts parity) lives in
 vitax/checkpoint/consolidate.py.
@@ -34,16 +43,29 @@ import atexit
 import json
 import os
 import re
-from typing import Any, Optional
+import sys
+import time
+from typing import Any, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
+from vitax import faults
 from vitax.utils.logging import master_print
 
 PyTree = Any
 
 _EPOCH_RE = re.compile(r"^epoch_(\d+)$")
+
+# Files only a *finalized* Orbax checkpoint dir contains: the checkpoint
+# metadata written at commit time (orbax >= 0.5), or the explicit commit
+# marker orbax drops on filesystems without atomic rename (GCS).
+COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
+
+# save_state transient-write retry policy (env-overridable: tests pin the
+# retry path with injected OSErrors and a near-zero backoff)
+DEFAULT_SAVE_RETRIES = 3
+DEFAULT_SAVE_RETRY_BACKOFF_S = 0.5
 
 _CKPTR: Optional[ocp.StandardCheckpointer] = None
 
@@ -99,15 +121,42 @@ def load_resume_step(ckpt_dir: str, epoch: int) -> Optional[int]:
         return None  # unreadable sidecar degrades to epoch-granular resume
 
 
-def latest_epoch(ckpt_dir: str) -> Optional[int]:
-    """Highest epoch with a complete checkpoint in ckpt_dir, or None."""
+def is_committed_checkpoint(path: str) -> bool:
+    """Did this checkpoint dir finish its commit? A hard crash mid-async-
+    write (or a non-atomic shared store) can leave a partial `epoch_N/`
+    whose name looks finished; the commit marker is written at finalize, so
+    its absence marks the dir torn."""
+    return os.path.isdir(path) and any(
+        os.path.exists(os.path.join(path, marker))
+        for marker in COMMIT_MARKERS)
+
+
+def committed_epochs(ckpt_dir: str) -> List[int]:
+    """Ascending epochs with a COMMITTED checkpoint in ckpt_dir. Torn dirs
+    (matching `epoch_<N>` but missing the commit marker) are skipped with a
+    warning — they are exactly what a crash mid-write leaves behind, and
+    resuming from one restores garbage or asserts."""
     if not os.path.isdir(ckpt_dir):
-        return None
+        return []
     epochs = []
-    for name in os.listdir(ckpt_dir):
+    for name in sorted(os.listdir(ckpt_dir)):
         m = _EPOCH_RE.match(name)
-        if m and not name.endswith(".tmp"):
+        if not m or name.endswith(".tmp"):
+            continue
+        if is_committed_checkpoint(os.path.join(ckpt_dir, name)):
             epochs.append(int(m.group(1)))
+        else:
+            master_print(f"vitax.checkpoint: skipping torn checkpoint "
+                         f"{os.path.join(ckpt_dir, name)} (no commit "
+                         f"marker — a crash mid-write left it partial)")
+    return sorted(epochs)
+
+
+def latest_epoch(ckpt_dir: str) -> Optional[int]:
+    """Highest epoch with a complete, COMMITTED checkpoint in ckpt_dir, or
+    None. The commit-marker validation makes `--resume_epoch -1` safe after
+    a hard crash mid-async-save."""
+    epochs = committed_epochs(ckpt_dir)
     return max(epochs) if epochs else None
 
 
@@ -125,10 +174,41 @@ def save_state(ckpt_dir: str, epoch: int, state: PyTree,
     completed steps): process 0 records it in a sidecar so resume can
     continue inside the epoch instead of skipping its remainder. An
     epoch-boundary save of the same epoch deletes any stale sidecar (the
-    stored state it described has been overwritten)."""
+    stored state it described has been overwritten).
+
+    Transient OSErrors at the write (a flaky shared filesystem, a full
+    scratch volume being reaped) are retried with capped exponential
+    backoff before surfacing — losing a 10B run to one EIO is worse than
+    waiting a second.
+
+    VITAX_CKPT_SYNC=1 forces wait=True on EVERY save — for fault drills
+    and tests where "the save returned" must mean "the checkpoint is
+    durable" (an injected crash a few steps after an epoch boundary
+    would otherwise race the background commit nondeterministically)."""
     path = epoch_ckpt_path(ckpt_dir, epoch)
+    wait = wait or os.environ.get("VITAX_CKPT_SYNC", "") == "1"
     ckptr = _checkpointer()
-    ckptr.save(path, state, force=True)
+    retries = int(os.environ.get("VITAX_SAVE_RETRIES", DEFAULT_SAVE_RETRIES))
+    backoff_s = float(os.environ.get("VITAX_SAVE_RETRY_BACKOFF_S",
+                                     DEFAULT_SAVE_RETRY_BACKOFF_S))
+    for attempt in range(max(retries, 1)):
+        try:
+            faults.fire("ckpt_write")  # one hook per ATTEMPT: `times` > 1
+            # in a fault plan exercises exactly this retry loop
+            ckptr.save(path, state, force=True)
+            break
+        except OSError as e:
+            if attempt + 1 >= max(retries, 1):
+                print(f"vitax.checkpoint: save of {path} failed after "
+                      f"{attempt + 1} attempt(s): {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+                raise
+            delay = backoff_s * (2 ** attempt)
+            print(f"vitax.checkpoint: transient save failure for {path} "
+                  f"(attempt {attempt + 1}/{retries}: {type(e).__name__}: "
+                  f"{e}); retrying in {delay:.2f}s", file=sys.stderr,
+                  flush=True)
+            time.sleep(delay)
     if wait:
         ckptr.wait_until_finished()
     if jax.process_index() == 0:
@@ -156,3 +236,29 @@ def restore_state(ckpt_dir: str, epoch: int, abstract_state: PyTree) -> PyTree:
     state = _checkpointer().restore(path, abstract_state)
     master_print(f"resumed from checkpoint {path}")
     return state
+
+
+def restore_state_with_fallback(ckpt_dir: str, epoch: int,
+                                abstract_state: PyTree,
+                                ) -> Tuple[PyTree, int]:
+    """restore_state, but when the requested (newest) epoch fails to restore
+    — corrupted array files behind an intact commit marker, a half-replicated
+    shared store — fall back, LOUDLY, to the previous committed epoch rather
+    than wedging auto-resume on one bad checkpoint. Returns (state, epoch
+    actually restored); raises only when every committed epoch fails."""
+    candidates = [ep for ep in committed_epochs(ckpt_dir) if ep <= epoch]
+    if epoch not in candidates:
+        candidates.append(epoch)  # honor an explicit ask even if unmarked
+    last_err: Optional[BaseException] = None
+    for ep in sorted(set(candidates), reverse=True):
+        try:
+            return restore_state(ckpt_dir, ep, abstract_state), ep
+        except Exception as e:  # noqa: BLE001 — fall back across ANY restore failure
+            last_err = e
+            print(f"vitax.checkpoint: RESTORE FAILED for epoch {ep} at "
+                  f"{epoch_ckpt_path(ckpt_dir, ep)} ({type(e).__name__}: "
+                  f"{e}); falling back to the previous committed epoch",
+                  file=sys.stderr, flush=True)
+    raise RuntimeError(
+        f"no committed epoch <= {epoch} in {ckpt_dir} could be restored"
+    ) from last_err

@@ -141,6 +141,16 @@ class Config:
     hang_timeout_s: float = 0.0         # >0: heartbeat watchdog — dump all-thread stacks + device
     #   memory (rank-tagged, job left running) after this many seconds
     #   without a completed step (vitax/telemetry/watchdog.py)
+    hang_action: str = "dump"           # dump = stacks only, job left running (PR 4 behavior);
+    #   checkpoint_exit = after the dump, emergency-save a committed mid-epoch
+    #   checkpoint at the next step boundary and exit with code 42 so a
+    #   supervisor (tools/supervise.py) restarts the run; a loop that never
+    #   reaches a boundary is hard-exited with the same code after a deadline
+    fault_plan: str = ""                # JSON fault-injection plan (vitax/faults.py; or the
+    #   VITAX_FAULT_PLAN env var): deterministic crash/hang/write-error/
+    #   loader-stall/SIGTERM drills at a chosen step or call site. "" (and
+    #   no env var) = every hook is a zero-cost no-op; the compiled step
+    #   program is identical either way (all hooks are host-side)
     compile_cache_dir: str = ""         # persistent XLA compile cache (restarts skip recompiles)
     debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
     log_memory: bool = True             # include HBM stats in step log
@@ -304,6 +314,15 @@ class Config:
         assert self.hang_timeout_s >= 0, (
             f"--hang_timeout_s must be >= 0 (0 = watchdog off), "
             f"got {self.hang_timeout_s}")
+        assert self.hang_action in ("dump", "checkpoint_exit"), (
+            f"unknown hang_action {self.hang_action!r} (expected 'dump' or "
+            f"'checkpoint_exit')")
+        if self.fault_plan:
+            from vitax import faults
+            try:  # fail at startup, not at the step the plan names
+                faults.parse_plan(self.fault_plan)
+            except ValueError as e:
+                raise AssertionError(f"--fault_plan invalid: {e}") from e
         if self.tensorboard:
             assert self.metrics_dir, (
                 "--tensorboard needs --metrics_dir: the TB event files live "
@@ -470,6 +489,20 @@ def build_parser() -> argparse.ArgumentParser:
                           "device memory stats (rank-tagged, without killing "
                           "the job) after this many seconds with no "
                           "completed step")
+    ext.add_argument("--hang_action", type=str, default="dump",
+                     choices=["dump", "checkpoint_exit"],
+                     help="what the watchdog does after its dump: dump = "
+                          "leave the job running (default); checkpoint_exit "
+                          "= emergency-save a committed checkpoint at the "
+                          "next step boundary and exit 42 for a supervisor "
+                          "(tools/supervise.py) to restart")
+    ext.add_argument("--fault_plan", type=str, default="",
+                     help="JSON fault-injection plan (vitax/faults.py), e.g. "
+                          "'{\"site\": \"step\", \"at\": 6, \"action\": "
+                          "\"crash\"}' — deterministic crash/hang/"
+                          "write-error/loader-stall/SIGTERM drills for the "
+                          "failure-reaction machinery (VITAX_FAULT_PLAN env "
+                          "var is the flagless equivalent)")
     ext.add_argument("--compile_cache_dir", type=str, default="")
     ext.add_argument("--debug_nans", action="store_true", dest="debug_nans")
     ext.add_argument("--no_log_memory", action="store_false", dest="log_memory")

@@ -24,6 +24,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterator, Optional, Sequence
 
@@ -31,8 +32,28 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from vitax import faults
 from vitax.config import Config
 from vitax.parallel.mesh import batch_pspec
+
+
+class LoaderWorkerError(RuntimeError):
+    """A data-worker (producer-thread) failure, re-raised on the CONSUMING
+    host with the worker's own traceback attached. Without this, a dead
+    producer just starves the consumer until the watchdog fires a dump with
+    no cause in it — the stall is visible, the broken sample is not."""
+
+
+class _ProducerFailure:
+    """Queue envelope for a producer exception + its formatted traceback
+    (the traceback object itself must not cross threads via re-raise: the
+    consumer's `raise` would show the consumer's stack, not the worker's)."""
+
+    __slots__ = ("exc", "tb")
+
+    def __init__(self, exc: BaseException, tb: str):
+        self.exc = exc
+        self.tb = tb
 
 
 class ShardedSampler:
@@ -141,9 +162,12 @@ class ShardedLoader:
                 for row in index_matrix:
                     if stop.is_set():
                         return
+                    faults.fire("loader")  # host-side drill point: a `stall`
+                    # here starves the consumer; an `oserror` exercises the
+                    # worker-traceback surfacing below
                     q.put(self._load_local(row))
             except BaseException as e:  # surface worker errors to the consumer
-                q.put(e)
+                q.put(_ProducerFailure(e, traceback.format_exc()))
             finally:
                 q.put(None)
 
@@ -156,8 +180,12 @@ class ShardedLoader:
                 self._wait_s += time.monotonic() - t_wait
                 if item is None:
                     return
-                if isinstance(item, BaseException):
-                    raise item
+                if isinstance(item, _ProducerFailure):
+                    raise LoaderWorkerError(
+                        f"data worker failed while producing epoch {epoch}: "
+                        f"{type(item.exc).__name__}: {item.exc}\n"
+                        f"--- worker traceback (vitax-prefetch thread) ---\n"
+                        f"{item.tb}") from item.exc
                 # device transfer is async in JAX — this enqueues the copies
                 # and returns; compute/transfer overlap still happens
                 yield self._to_device(item)

@@ -1,0 +1,221 @@
+"""Deterministic fault injection: chaos drills for the failure-reaction layer.
+
+Elastic-training machinery (watchdog escalation, the supervisor's restart
+loop, checkpoint-write retries, torn-checkpoint skip) is only trustworthy if
+it is *exercised* — preemption and hangs on real pods do not arrive on a test
+schedule. This module injects them on one: a JSON **fault plan**
+(``--fault_plan`` or the ``VITAX_FAULT_PLAN`` env var) names a hook site, a
+1-based call index at that site, and an action:
+
+    {"site": "step", "at": 6, "action": "crash", "exit_code": 13}
+
+or a list of such specs (optionally wrapped as ``{"faults": [...]}``).
+
+Sites (each a single host-side hook point; see the wiring modules):
+  step        once per dispatched optimizer step, index = global step count
+              (vitax/train/loop.py)
+  ckpt_write  once per checkpoint write *attempt*, so ``times`` > 1 exercises
+              the save retry path (vitax/checkpoint/orbax_io.py)
+  loader      once per produced host batch, on the producer thread
+              (vitax/data/loader.py)
+
+Actions:
+  crash    os._exit(exit_code) — a hard kill: no atexit, no drains, exactly
+           what a segfault/OOM-kill leaves behind (default exit code 13)
+  hang     time.sleep(seconds) on the hooked thread (default 3600) — drives
+           the watchdog past --hang_timeout_s
+  oserror  raise OSError at the hook — a transient write/read failure
+  stall    alias of hang for the loader site (a starved consumer)
+  sigterm  os.kill(os.getpid(), SIGTERM) — a self-delivered preemption notice
+
+Every spec is deterministic: it fires when the site's call index (the
+explicit ``index=`` the hook passes, else an internal per-site counter)
+lands in [at, at + times). With no plan installed the hooks are a single
+module-global ``is None`` check — zero-cost, and the compiled step program
+is bit-identical with a plan armed or not (all hooks are host-side;
+tests/test_faults.py pins that like telemetry did in PR 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+SITES = ("step", "ckpt_write", "loader")
+ACTIONS = ("crash", "hang", "oserror", "stall", "sigterm")
+
+DEFAULT_CRASH_EXIT_CODE = 13
+DEFAULT_HANG_SECONDS = 3600.0
+
+ENV_VAR = "VITAX_FAULT_PLAN"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault: fire `action` at call indices [at, at + times)."""
+
+    site: str
+    action: str
+    at: int = 1
+    times: int = 1
+    exit_code: int = DEFAULT_CRASH_EXIT_CODE
+    seconds: float = DEFAULT_HANG_SECONDS
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"fault_plan: unknown site {self.site!r} "
+                             f"(expected one of {SITES})")
+        if self.action not in ACTIONS:
+            raise ValueError(f"fault_plan: unknown action {self.action!r} "
+                             f"(expected one of {ACTIONS})")
+        if self.at < 1:
+            raise ValueError(f"fault_plan: `at` is a 1-based call index, "
+                             f"got {self.at}")
+        if self.times < 1:
+            raise ValueError(f"fault_plan: `times` must be >= 1, got {self.times}")
+        if self.seconds < 0:
+            raise ValueError(f"fault_plan: `seconds` must be >= 0, got {self.seconds}")
+
+    @staticmethod
+    def from_dict(d: dict) -> "FaultSpec":
+        known = {f.name for f in dataclasses.fields(FaultSpec)}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"fault_plan: unknown keys {sorted(extra)} "
+                             f"(expected a subset of {sorted(known)})")
+        if "site" not in d or "action" not in d:
+            raise ValueError("fault_plan: every spec needs `site` and `action`")
+        return FaultSpec(**d)
+
+    def describe(self) -> str:
+        arg = {"crash": f"exit_code={self.exit_code}",
+               "hang": f"seconds={self.seconds:g}",
+               "stall": f"seconds={self.seconds:g}"}.get(self.action, "")
+        window = (f"at={self.at}" if self.times == 1
+                  else f"at={self.at}..{self.at + self.times - 1}")
+        return f"{self.site}:{self.action}({window}{', ' + arg if arg else ''})"
+
+
+class FaultPlan:
+    """A parsed plan plus per-site call counters (thread-safe: the loader
+    site fires on the producer thread while `step` fires on the consumer)."""
+
+    def __init__(self, specs: List[FaultSpec]):
+        self.specs = list(specs)
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def describe(self) -> str:
+        return ", ".join(s.describe() for s in self.specs) or "(empty)"
+
+    def fire(self, site: str, index: Optional[int] = None) -> None:
+        """Run any fault scheduled for this call of `site`. The internal
+        per-site counter advances on EVERY call so plans stay deterministic
+        whether or not the hook passes an explicit index."""
+        with self._lock:
+            self._counters[site] = self._counters.get(site, 0) + 1
+            idx = self._counters[site] if index is None else index
+        for spec in self.specs:
+            if spec.site == site and spec.at <= idx < spec.at + spec.times:
+                _act(spec, idx)
+
+
+def _act(spec: FaultSpec, index: int) -> None:
+    payload = {"site": spec.site, "action": spec.action, "index": index}
+    reporter = _REPORTER
+    if reporter is not None:
+        try:
+            reporter(payload)  # JSONL sinks flush per record: the event
+            # survives even the crash action's os._exit below
+        except Exception as e:  # noqa: BLE001 — reporting must not mask the drill
+            print(f"vitax.faults: reporter failed ({type(e).__name__}: {e})",
+                  file=sys.stderr, flush=True)
+    print(f"vitax.faults: injecting {spec.describe()} (call {index})",
+          file=sys.stderr, flush=True)
+    if spec.action == "crash":
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(spec.exit_code)
+    elif spec.action in ("hang", "stall"):
+        time.sleep(spec.seconds)
+    elif spec.action == "oserror":
+        raise OSError(f"injected fault: {spec.describe()} (call {index})")
+    elif spec.action == "sigterm":
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+# --- module-level registry: the hooks the subsystems call -------------------
+
+_PLAN: Optional[FaultPlan] = None
+_REPORTER: Optional[Callable[[dict], None]] = None
+
+
+def parse_plan(plan_json: str) -> FaultPlan:
+    """Parse + validate a plan string (raises ValueError on any problem —
+    config.validate() calls this so a bad plan fails at startup, not at
+    step N)."""
+    try:
+        data = json.loads(plan_json)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"fault_plan: not valid JSON ({e})") from e
+    if isinstance(data, dict) and "faults" in data:
+        data = data["faults"]
+    if isinstance(data, dict):
+        data = [data]
+    if not isinstance(data, list):
+        raise ValueError("fault_plan: expected a spec object, a list of "
+                         "them, or {\"faults\": [...]}")
+    specs = [FaultSpec.from_dict(d) for d in data]
+    if not specs:
+        raise ValueError("fault_plan: empty plan — drop the flag instead")
+    return FaultPlan(specs)
+
+
+def install(plan_json: str) -> FaultPlan:
+    """Arm a plan (replacing any previous one); returns it."""
+    global _PLAN
+    _PLAN = parse_plan(plan_json)
+    return _PLAN
+
+
+def install_from_config(cfg) -> Optional[FaultPlan]:
+    """Arm the plan named by --fault_plan, else VITAX_FAULT_PLAN, else
+    nothing. Called once per train() so every (supervised) restart re-arms
+    the same deterministic plan."""
+    plan_json = getattr(cfg, "fault_plan", "") or os.environ.get(ENV_VAR, "")
+    if not plan_json:
+        uninstall()
+        return None
+    return install(plan_json)
+
+
+def uninstall() -> None:
+    """Disarm (idempotent); hooks return to the zero-cost no-op path."""
+    global _PLAN, _REPORTER
+    _PLAN = None
+    _REPORTER = None
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def set_reporter(reporter: Optional[Callable[[dict], None]]) -> None:
+    """Wire fired faults to telemetry (the loop passes
+    ``lambda p: recorder.event("fault", **p)``); None clears."""
+    global _REPORTER
+    _REPORTER = reporter
+
+
+def fire(site: str, index: Optional[int] = None) -> None:
+    """The hook the subsystems call. With no plan armed this is one global
+    read — cheap enough for once-per-step call sites."""
+    if _PLAN is None:
+        return
+    _PLAN.fire(site, index)

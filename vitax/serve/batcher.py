@@ -136,5 +136,5 @@ class DynamicBatcher:
                 self.on_batch({"batch_size": n, "bucket": bucket,
                                "infer_s": infer_s,
                                "queue_wait_s_max": t_flush - batch[0][2]})
-            except Exception:  # noqa: BLE001 — telemetry must not kill serving
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] telemetry must not kill serving
                 pass

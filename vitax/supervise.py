@@ -1,0 +1,301 @@
+"""Supervised auto-restart: run training as a subprocess and keep it alive.
+
+The reaction half of the elastic-training loop (ROADMAP open item 4, Varuna-
+style, Athlur et al. 2022): PRs 4-6 made failures *detectable* (watchdog
+dumps, preemption saves, telemetry) — this module makes them *survivable*
+without an operator. The supervisor launches the training command with
+``--resume_epoch -1`` forced on (auto-resume from the latest COMMITTED
+checkpoint, vitax/checkpoint/orbax_io.py), then:
+
+- restarts on any nonzero exit — a fault crash, an OOM-kill, the watchdog's
+  escalation exit (code 42, vitax/telemetry/watchdog.py EXIT_HANG) — with
+  capped exponential backoff and a total restart budget;
+- detects CRASH LOOPS: a child that dies without advancing the checkpoint
+  frontier (latest committed epoch + resume-step sidecar) is burning the
+  budget on a deterministic bug, not riding out flaky infrastructure — after
+  ``crash_loop_tolerance`` consecutive no-progress deaths the supervisor
+  gives up with EXIT_BUDGET (3) so the launcher sees a *distinct* failure;
+- forwards SIGTERM/SIGINT to the child exactly once for a clean preemption
+  drain (the child's preempt.py path saves and exits 0; the supervisor
+  passes that code through instead of restarting), hard-killing after
+  ``term_grace_s``;
+- appends ``kind:"restart"`` schema-1 events to ``<metrics_dir>/
+  metrics.jsonl`` — the same stream the child's Recorder writes — so
+  tools/metrics_report.py surfaces restart count and last exit code.
+
+Exit-code contract:
+  0           child completed (or drained cleanly after a forwarded SIGTERM)
+  EXIT_BUDGET (3) restart budget exhausted or crash loop detected
+  (anything else: the child's own final code, passed through on SIGTERM)
+
+CLI: ``python tools/supervise.py [flags] -- python run_vit_training.py ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+EXIT_BUDGET = 3  # distinct from the child's codes: the SUPERVISOR gave up
+
+DEFAULT_MAX_RESTARTS = 10
+DEFAULT_BACKOFF_S = 1.0
+DEFAULT_BACKOFF_MAX_S = 60.0
+DEFAULT_CRASH_LOOP_TOLERANCE = 2
+DEFAULT_TERM_GRACE_S = 30.0
+
+SCHEMA_VERSION = 1  # matches vitax.telemetry.record.SCHEMA_VERSION (kept
+# literal here so the supervisor never imports the jax-backed telemetry
+# stack into its own lightweight process)
+
+
+def ensure_auto_resume(argv: Sequence[str]) -> List[str]:
+    """Force --resume_epoch -1 on the child command: a supervised restart
+    that re-trains from scratch (the default resume_epoch=0) would silently
+    discard every committed epoch."""
+    argv = list(argv)
+    for i, arg in enumerate(argv):
+        if arg == "--resume_epoch":
+            if i + 1 < len(argv):
+                argv[i + 1] = "-1"
+            return argv
+        if arg.startswith("--resume_epoch="):
+            argv[i] = "--resume_epoch=-1"
+            return argv
+    return argv + ["--resume_epoch", "-1"]
+
+
+def scrape_flag(argv: Sequence[str], flag: str) -> Optional[str]:
+    """Value of `flag` in a child argv (both `--flag v` and `--flag=v`)."""
+    for i, arg in enumerate(argv):
+        if arg == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith(flag + "="):
+            return arg.split("=", 1)[1]
+    return None
+
+
+def checkpoint_progress(ckpt_dir: str) -> Tuple[int, int]:
+    """The child's durable progress frontier: (latest committed epoch,
+    resume-sidecar step of that epoch). Tuple-ordered so any committed
+    advance — a new epoch, or a later mid-epoch preemption/escalation save
+    of the same epoch — counts as progress between restarts."""
+    from vitax.checkpoint.orbax_io import committed_epochs, load_resume_step
+    epochs = committed_epochs(ckpt_dir)
+    if not epochs:
+        return (0, 0)
+    latest = epochs[-1]
+    return (latest, load_resume_step(ckpt_dir, latest) or 0)
+
+
+class Supervisor:
+    """Restart loop around one training subprocess.
+
+    `spawn`, `progress_fn` and `sleep` are injectable so the restart /
+    backoff / crash-loop logic is unit-testable on a fake child with no real
+    processes (tests/test_faults.py)."""
+
+    def __init__(self, child_argv: Sequence[str], ckpt_dir: str,
+                 metrics_dir: str = "",
+                 max_restarts: int = DEFAULT_MAX_RESTARTS,
+                 backoff_s: float = DEFAULT_BACKOFF_S,
+                 backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
+                 crash_loop_tolerance: int = DEFAULT_CRASH_LOOP_TOLERANCE,
+                 term_grace_s: float = DEFAULT_TERM_GRACE_S,
+                 spawn: Optional[Callable] = None,
+                 progress_fn: Optional[Callable[[], Tuple]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 poll_interval_s: float = 0.1):
+        assert max_restarts >= 0, max_restarts
+        assert crash_loop_tolerance >= 0, crash_loop_tolerance
+        assert backoff_s >= 0 and backoff_max_s >= 0
+        self.child_argv = ensure_auto_resume(child_argv)
+        self.ckpt_dir = ckpt_dir
+        self.metrics_dir = metrics_dir
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.crash_loop_tolerance = crash_loop_tolerance
+        self.term_grace_s = term_grace_s
+        self.poll_interval_s = poll_interval_s
+        self._spawn = spawn or (lambda argv: subprocess.Popen(argv))
+        self._progress = progress_fn or (
+            lambda: checkpoint_progress(self.ckpt_dir))
+        self._sleep = sleep
+        self.restart_count = 0
+        self.last_exit_code: Optional[int] = None
+        self._term_requested = False
+        self._term_forwarded = False
+
+    # -- signal forwarding ---------------------------------------------------
+    def _on_term(self, signum, frame):  # noqa: ARG002 — signal handler signature
+        self._term_requested = True
+
+    def _install_handlers(self) -> None:
+        try:
+            signal.signal(signal.SIGTERM, self._on_term)
+            signal.signal(signal.SIGINT, self._on_term)
+        except ValueError:
+            pass  # not the main thread (tests): forwarding unavailable
+
+    # -- telemetry -----------------------------------------------------------
+    def _event(self, **payload) -> None:
+        """Append one schema-1 event to the run's metrics.jsonl (the child is
+        not running while the supervisor writes, so the append interleaves
+        with the Recorder's stream only at line granularity — which JSONL is
+        built for). Fail-soft: supervision must not die over observability."""
+        record = {"schema": SCHEMA_VERSION, "time": time.time(),
+                  "kind": "restart", "rank": 0, **payload}
+        self._log(f"restart {payload.get('restart')}: child exit "
+                  f"{payload.get('exit_code')}, "
+                  f"{'progress' if payload.get('progress') else 'NO progress'}"
+                  f" since last start, backing off "
+                  f"{payload.get('backoff_s'):.2f}s")
+        if not self.metrics_dir:
+            return
+        try:
+            os.makedirs(self.metrics_dir, exist_ok=True)
+            path = os.path.join(self.metrics_dir, "metrics.jsonl")
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record, sort_keys=True) + "\n")
+        except OSError as e:
+            self._log(f"cannot write restart event ({e}); continuing")
+
+    @staticmethod
+    def _log(msg: str) -> None:
+        print(f"[vitax.supervise] {msg}", file=sys.stderr, flush=True)
+
+    # -- child lifecycle -----------------------------------------------------
+    def _wait(self, child) -> int:
+        """Wait for the child, forwarding one SIGTERM when asked and
+        hard-killing after the grace window."""
+        kill_at: Optional[float] = None
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                return rc
+            if self._term_requested and not self._term_forwarded:
+                self._term_forwarded = True
+                self._log(f"forwarding SIGTERM to the child (clean drain; "
+                          f"hard kill after {self.term_grace_s:.0f}s)")
+                try:
+                    child.send_signal(signal.SIGTERM)
+                except (OSError, ValueError):
+                    pass  # already gone: the next poll() returns its code
+                kill_at = time.monotonic() + self.term_grace_s
+            if kill_at is not None and time.monotonic() >= kill_at:
+                self._log("grace window passed; killing the child")
+                try:
+                    child.kill()
+                except (OSError, ValueError):
+                    pass
+                kill_at = None
+            self._sleep(self.poll_interval_s)
+
+    def run(self) -> int:
+        self._install_handlers()
+        no_progress = 0
+        self._log(f"supervising: {' '.join(map(str, self.child_argv))}")
+        while True:
+            before = self._progress()
+            child = self._spawn(self.child_argv)
+            rc = self._wait(child)
+            self.last_exit_code = rc
+            if self._term_requested:
+                # the drain was OURS to request: pass the child's code
+                # through (0 for a clean preemption save) — the scheduler is
+                # taking the host, restarting here would fight it
+                self._log(f"child exited {rc} after forwarded SIGTERM; "
+                          f"supervisor exiting")
+                return rc
+            if rc == 0:
+                self._log("child completed cleanly")
+                return 0
+            after = self._progress()
+            progressed = after > before  # tuple order: (epoch, step_in_epoch)
+            no_progress = 0 if progressed else no_progress + 1
+            if no_progress > self.crash_loop_tolerance:
+                self._log(
+                    f"CRASH LOOP: {no_progress} consecutive exit(s) with no "
+                    f"checkpoint progress (frontier {after}); giving up with "
+                    f"exit {EXIT_BUDGET}")
+                return EXIT_BUDGET
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                self._log(f"restart budget ({self.max_restarts}) exhausted; "
+                          f"giving up with exit {EXIT_BUDGET}")
+                return EXIT_BUDGET
+            delay = min(self.backoff_s * (2 ** (self.restart_count - 1)),
+                        self.backoff_max_s)
+            self._event(exit_code=rc, restart=self.restart_count,
+                        backoff_s=delay, progress=progressed,
+                        epoch=after[0], step_in_epoch=after[1])
+            if delay > 0:
+                self._sleep(delay)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python tools/supervise.py",
+        description="supervised auto-restart for vitax training: "
+                    "`python tools/supervise.py [flags] -- python "
+                    "run_vit_training.py ...` (the child is forced to "
+                    "--resume_epoch -1)")
+    p.add_argument("--ckpt_dir", type=str, default="",
+                   help="checkpoint dir for crash-loop progress detection "
+                        "(default: scraped from the child command's "
+                        "--ckpt_dir, else the trainer's default)")
+    p.add_argument("--metrics_dir", type=str, default="",
+                   help="append kind:'restart' events to <metrics_dir>/"
+                        "metrics.jsonl (default: scraped from the child "
+                        "command)")
+    p.add_argument("--max_restarts", type=int, default=DEFAULT_MAX_RESTARTS,
+                   help="total restarts before giving up with exit "
+                        f"{EXIT_BUDGET}")
+    p.add_argument("--backoff_s", type=float, default=DEFAULT_BACKOFF_S,
+                   help="first restart delay; doubles per restart")
+    p.add_argument("--backoff_max_s", type=float,
+                   default=DEFAULT_BACKOFF_MAX_S, help="backoff cap")
+    p.add_argument("--crash_loop_tolerance", type=int,
+                   default=DEFAULT_CRASH_LOOP_TOLERANCE,
+                   help="consecutive no-checkpoint-progress exits tolerated "
+                        f"before giving up with exit {EXIT_BUDGET}")
+    p.add_argument("--term_grace_s", type=float, default=DEFAULT_TERM_GRACE_S,
+                   help="seconds a SIGTERM-forwarded child gets to drain "
+                        "before a hard kill")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" not in argv:
+        print("supervise: missing child command — usage: "
+              "python tools/supervise.py [flags] -- python "
+              "run_vit_training.py ...", file=sys.stderr)
+        return 2
+    split = argv.index("--")
+    own, child = argv[:split], argv[split + 1:]
+    if not child:
+        print("supervise: empty child command after --", file=sys.stderr)
+        return 2
+    args = build_parser().parse_args(own)
+    ckpt_dir = (args.ckpt_dir or scrape_flag(child, "--ckpt_dir")
+                or "/tmp/vit_fsdp")  # the trainer's own default
+    metrics_dir = args.metrics_dir or scrape_flag(child, "--metrics_dir") or ""
+    sup = Supervisor(
+        child, ckpt_dir, metrics_dir=metrics_dir,
+        max_restarts=args.max_restarts, backoff_s=args.backoff_s,
+        backoff_max_s=args.backoff_max_s,
+        crash_loop_tolerance=args.crash_loop_tolerance,
+        term_grace_s=args.term_grace_s)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

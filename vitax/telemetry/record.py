@@ -102,7 +102,7 @@ class Recorder:
         for sink in self.sinks:
             try:
                 sink.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] a failing sink must not break the others' close
                 pass
 
 

@@ -68,7 +68,7 @@ class TensorBoardSink:
     def close(self) -> None:
         try:
             self._writer.close()
-        except Exception:  # noqa: BLE001 — close must never raise at exit
+        except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] close must never raise at interpreter exit
             pass
 
 

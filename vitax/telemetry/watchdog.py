@@ -1,4 +1,5 @@
-"""Hang watchdog: detect a stalled training loop and dump the evidence.
+"""Hang watchdog: detect a stalled training loop, dump the evidence, and —
+when asked — escalate so a supervisor can restart the job.
 
 Large jobs die quietly: a wedged collective, a deadlocked host thread or a
 starved input queue all look like "the log stopped". The watchdog is a
@@ -13,17 +14,37 @@ without a pet it dumps — WITHOUT killing the job —
 rank-tagged to stderr on every host, plus a structured `kind="hang"` JSONL
 event through the Recorder where one is attached (rank 0). It fires at most
 once per stall: after a dump it stays quiet until the next pet proves the
-loop moved again (MegaScale-style hang detection, Jiang et al. 2024 — the
-job is left alive for the operator or an external supervisor to decide).
+loop moved again (MegaScale-style hang detection, Jiang et al. 2024).
+
+Escalation (--hang_action checkpoint_exit): after the dump the watchdog sets
+a STICKY escalation flag and emits a `kind="hang_escalation"` event. The
+train loop polls the flag at the step boundary — the same flag-then-poll
+design as vitax/train/preempt.py, because the watchdog thread must never
+touch device state — takes an emergency committed checkpoint, and exits with
+EXIT_HANG (42) for the supervisor (vitax/supervise.py) to restart. If the
+loop never reaches a boundary (the hang is real and hard) the watchdog
+itself `os._exit`s with the same code once `hard_deadline_s` more seconds
+pass, so a wedged device cannot pin the process forever; the loop's
+`acknowledge_escalation()` re-arms that deadline to protect the emergency
+save in progress. With the default --hang_action dump the job is left
+running, exactly as before.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
 import traceback
 from typing import Callable, Optional
+
+# The escalation exit-code contract: a supervisor treats this as "the child
+# asked to be restarted after a hang" (any committed emergency checkpoint is
+# picked up by --resume_epoch -1). Distinct from crash codes and from 0.
+EXIT_HANG = 42
+
+HANG_ACTIONS = ("dump", "checkpoint_exit")
 
 
 def dump_all_stacks() -> str:
@@ -43,22 +64,51 @@ class Watchdog:
     `on_fire(payload: dict)` runs in the watchdog thread on each dump (the
     loop wires it to Recorder.event("hang", ...)); `fire_count` counts dumps
     over the watchdog's lifetime (tests assert it stays 0 on healthy runs).
+
+    With `action="checkpoint_exit"`, the first dump of a stall also requests
+    escalation: `escalation_requested()` turns (stickily) True for the loop
+    to poll, `on_escalate(payload)` runs once, and a hard deadline of
+    `hard_deadline_s` (default 2 x timeout_s) starts ticking — if neither
+    `acknowledge_escalation()` nor `stop()` arrives in time, the watchdog
+    hard-exits the process with EXIT_HANG (`hard_exit` is injectable so
+    tests never die for real).
     """
 
     def __init__(self, timeout_s: float,
                  on_fire: Optional[Callable[[dict], None]] = None,
-                 rank: int = 0, poll_s: Optional[float] = None):
+                 rank: int = 0, poll_s: Optional[float] = None,
+                 action: str = "dump",
+                 hard_deadline_s: Optional[float] = None,
+                 on_escalate: Optional[Callable[[dict], None]] = None,
+                 hard_exit: Callable[[int], None] = os._exit):
         assert timeout_s > 0, timeout_s
+        assert action in HANG_ACTIONS, action
         self.timeout_s = float(timeout_s)
         self.on_fire = on_fire
         self.rank = rank
+        self.action = action
+        self.hard_deadline_s = (float(hard_deadline_s) if hard_deadline_s
+                                else 2.0 * self.timeout_s)
+        self.on_escalate = on_escalate
+        self._hard_exit = hard_exit
         # poll often enough to notice promptly, rarely enough to cost nothing
         self.poll_s = poll_s if poll_s else min(max(timeout_s / 4.0, 0.05), 5.0)
         self.fire_count = 0
         self._last_pet = time.monotonic()
         self._fired_since_pet = False
+        self._escalated = threading.Event()
+        self._hard_deadline_at: Optional[float] = None  # monotonic
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def running(self) -> bool:
+        """Has start() been called? The train loop arms the watchdog at the
+        FIRST dispatch return — i.e. after XLA compilation — so the hang
+        window never spans compile time and --hang_timeout_s can be far
+        smaller than a 10B-scale compile (minutes). Before that, a stall is
+        "still compiling", not a hang."""
+        return self._thread is not None
 
     def start(self) -> "Watchdog":
         self._last_pet = time.monotonic()
@@ -68,9 +118,21 @@ class Watchdog:
         return self
 
     def pet(self) -> None:
-        """The loop made progress; re-arm."""
+        """The loop made progress; re-arm the dump (NOT the escalation: once
+        requested, the loop must checkpoint and exit — a step that limps
+        through after a real hang is not a healthy run)."""
         self._last_pet = time.monotonic()
         self._fired_since_pet = False
+
+    def escalation_requested(self) -> bool:
+        """Sticky: True once a stall under action="checkpoint_exit" dumped."""
+        return self._escalated.is_set()
+
+    def acknowledge_escalation(self) -> None:
+        """The loop saw the flag and is taking the emergency checkpoint:
+        push the hard-exit deadline out by another hard_deadline_s so the
+        save itself runs under the same bounded protection."""
+        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
 
     def stop(self) -> None:
         self._stop.set()
@@ -83,6 +145,17 @@ class Watchdog:
             if stalled >= self.timeout_s and not self._fired_since_pet:
                 self._fired_since_pet = True  # once per stall, not per poll
                 self._fire(stalled)
+            if (self._hard_deadline_at is not None
+                    and time.monotonic() >= self._hard_deadline_at):
+                self._hard_exit_now()
+
+    def _hard_exit_now(self) -> None:
+        print(f"[vitax.watchdog rank {self.rank}] escalation deadline "
+              f"({self.hard_deadline_s:.1f}s) passed without the loop "
+              f"reaching a step boundary — hard-exiting with code "
+              f"{EXIT_HANG} for the supervisor", file=sys.stderr, flush=True)
+        self._hard_deadline_at = None  # a test's fake exit returns; disarm
+        self._hard_exit(EXIT_HANG)
 
     def _fire(self, stalled_s: float) -> None:
         self.fire_count += 1
@@ -92,9 +165,15 @@ class Watchdog:
         except Exception as e:  # noqa: BLE001 — a dead backend must not mute the dump
             mem = {"error": f"{type(e).__name__}: {e}"}
         stacks = dump_all_stacks()
+        escalating = (self.action == "checkpoint_exit"
+                      and not self._escalated.is_set())
+        verdict = (f"escalating: emergency checkpoint + exit {EXIT_HANG} at "
+                   f"the next step boundary (hard deadline "
+                   f"{self.hard_deadline_s:.1f}s)" if escalating
+                   else "job left running")
         print(f"[vitax.watchdog rank {self.rank}] no step progress for "
               f"{stalled_s:.1f}s (timeout {self.timeout_s:.1f}s); dumping "
-              f"all-thread stacks + device memory (job left running)\n"
+              f"all-thread stacks + device memory ({verdict})\n"
               f"{stacks}\n[vitax.watchdog rank {self.rank}] memory: {mem}",
               file=sys.stderr, flush=True)
         if self.on_fire is not None:
@@ -104,5 +183,24 @@ class Watchdog:
                               "stacks": stacks, **mem})
             except Exception as e:  # noqa: BLE001
                 print(f"[vitax.watchdog rank {self.rank}] on_fire sink "
+                      f"failed: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+        if escalating:
+            self._escalate(stalled_s)
+
+    def _escalate(self, stalled_s: float) -> None:
+        # order matters: arm the deadline BEFORE raising the flag, so a loop
+        # that polls immediately can only ever see a flag whose deadline is
+        # already running (acknowledge then safely re-arms it)
+        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
+        self._escalated.set()
+        if self.on_escalate is not None:
+            try:  # JSONL sinks flush per record: the event survives the exit
+                self.on_escalate({"stalled_s": round(stalled_s, 3),
+                                  "timeout_s": self.timeout_s,
+                                  "exit_code": EXIT_HANG,
+                                  "hard_deadline_s": self.hard_deadline_s})
+            except Exception as e:  # noqa: BLE001
+                print(f"[vitax.watchdog rank {self.rank}] on_escalate sink "
                       f"failed: {type(e).__name__}: {e}",
                       file=sys.stderr, flush=True)

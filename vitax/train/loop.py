@@ -10,6 +10,7 @@ step and fetch them when logging.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import pprint
 import time
@@ -18,8 +19,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from vitax import distributed, platform
-from vitax.checkpoint import restore_state, save_state
+from vitax import distributed, faults, platform
+from vitax.checkpoint import (restore_state, restore_state_with_fallback,
+                              save_state)
 from vitax.config import Config
 from vitax.data import build_datasets
 from vitax.models import build_model, count_params
@@ -27,6 +29,7 @@ from vitax.parallel.mesh import BATCH_AXES, build_mesh
 from vitax.train.state import TrainState, build_optimizer, make_train_state
 from vitax.train.step import make_eval_step, make_train_step
 from vitax.telemetry import Watchdog, build_recorder
+from vitax.telemetry.watchdog import EXIT_HANG
 from vitax.utils.logging import master_print, memory_summary
 from vitax.utils.metrics import SmoothedValue
 
@@ -64,6 +67,12 @@ def train(cfg: Config) -> TrainState:
         jax.config.update("jax_compilation_cache_dir", cfg.compile_cache_dir)
 
     master_print(f"\n=== cfg ===\n{pprint.pformat(cfg)}\n")
+    # deterministic fault injection (--fault_plan / VITAX_FAULT_PLAN): armed
+    # before any hook site can fire, re-armed identically on every
+    # (supervised) restart; a no-plan run pays one `is None` check per hook
+    fault_plan = faults.install_from_config(cfg)
+    if fault_plan is not None:
+        master_print(f"fault injection ARMED (drill): {fault_plan.describe()}")
     mesh = build_mesh(cfg)
     master_print(f"mesh: {dict(mesh.shape)} over {jax.device_count()} devices "
                  f"({jax.process_count()} host(s))")
@@ -75,11 +84,13 @@ def train(cfg: Config) -> TrainState:
     master_print(f"\n=== dataset ===\n{pprint.pformat(train_ds)}\n")
 
     # --- model + optimizer, born sharded (reference :228-242) ---
-    if cfg.resume_epoch < 0:  # auto-resume: latest complete checkpoint, if any
+    auto_resume = cfg.resume_epoch < 0
+    if auto_resume:  # auto-resume: latest COMMITTED checkpoint, if any
         from vitax.checkpoint.orbax_io import latest_epoch
-        import dataclasses
         # process 0 picks, everyone adopts: a non-atomic shared-store view
-        # (e.g. GCS fuse) must not let hosts disagree on the resume epoch
+        # (e.g. GCS fuse) must not let hosts disagree on the resume epoch;
+        # latest_epoch validates the Orbax commit marker, so a torn dir a
+        # crash left mid-write is never selected
         found = distributed.broadcast_from_process0(latest_epoch(cfg.ckpt_dir) or 0)
         cfg = dataclasses.replace(cfg, resume_epoch=found)
         master_print(f"auto-resume: {'epoch ' + str(found) if found else 'no checkpoint found, fresh start'}")
@@ -104,7 +115,18 @@ def train(cfg: Config) -> TrainState:
         cfg, model, tx, mesh, jax.random.key(cfg.seed),
         materialize=cfg.resume_epoch <= 0)
     if cfg.resume_epoch > 0:
-        state = restore_state(cfg.ckpt_dir, cfg.resume_epoch, state)
+        if auto_resume:
+            # an auto-resume must survive one bad checkpoint: fall back to
+            # the previous committed epoch (loudly) instead of wedging
+            state, restored = restore_state_with_fallback(
+                cfg.ckpt_dir, cfg.resume_epoch, state)
+            if restored != cfg.resume_epoch:
+                cfg = dataclasses.replace(cfg, resume_epoch=restored)
+                from vitax.checkpoint.orbax_io import load_resume_step
+                resume_step = distributed.broadcast_from_process0(
+                    load_resume_step(cfg.ckpt_dir, restored) or 0)
+        else:  # an explicit --resume_epoch N must mean N — fail hard
+            state = restore_state(cfg.ckpt_dir, cfg.resume_epoch, state)
     distributed.barrier("loaded model")
     master_print(f"\n=== model ===\n{model}\n")
     master_print(f"global parameter num: {count_params(state.params)}")
@@ -149,14 +171,28 @@ def train(cfg: Config) -> TrainState:
                        peak_tflops=recorder.peak_tflops,
                        flops_per_step=recorder.flops_per_step,
                        batch_size=cfg.batch_size)
+        if fault_plan is not None:  # fired faults become kind:"fault" events
+            faults.set_reporter(
+                lambda payload: recorder.event("fault", **payload))
     watchdog = None
     if cfg.hang_timeout_s > 0:
         on_fire = ((lambda payload: recorder.event("hang", **payload))
                    if recorder is not None else None)
+        on_escalate = ((lambda payload: recorder.event("hang_escalation",
+                                                       **payload))
+                       if recorder is not None else None)
+        # built here, ARMED at the first dispatch return (see the step loop):
+        # the first step blocks on XLA compilation — minutes at 10B scale —
+        # and a watchdog ticking through it would escalate on a healthy run
         watchdog = Watchdog(cfg.hang_timeout_s, on_fire=on_fire,
-                            rank=jax.process_index()).start()
-        master_print(f"watchdog: stack+memory dump after "
-                     f"{cfg.hang_timeout_s:.0f}s without a completed step")
+                            rank=jax.process_index(),
+                            action=cfg.hang_action,
+                            on_escalate=on_escalate)
+        master_print(
+            f"watchdog: stack+memory dump after {cfg.hang_timeout_s:.0f}s "
+            f"without a completed step (armed after the compile step)"
+            + (f", then emergency checkpoint + exit {EXIT_HANG}"
+               if cfg.hang_action == "checkpoint_exit" else ""))
 
     distributed.barrier("training begins")
     master_print("training begins (the first few iterations are very slow due to compilation)")
@@ -179,6 +215,7 @@ def train(cfg: Config) -> TrainState:
         wait_until_finished()  # drain any in-flight async save before exit
         if recorder is not None:
             recorder.close()
+        faults.uninstall()  # fault plans are per-run, like the recorder
         preempt.uninstall()  # restore normal SIGTERM for post-training work
 
     master_print("training completed")
@@ -236,12 +273,23 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 prof["on"] = True
             state, metrics = train_step(state, batch, data_rng)
             total_steps += 1
+            # fault drill point (no-op without a plan): fires BEFORE the pet
+            # so an injected hang starves the watchdog exactly like a real
+            # wedged step; index = the global step count, so plans are
+            # deterministic across restarts of the same config
+            faults.fire("step", index=total_steps)
             steps_since_record += 1
             if watchdog is not None:
                 # pet on dispatch, not completion: the loop is alive; a wedged
                 # DEVICE stalls the next log step's fence, which stops pets
-                # within log_step_interval dispatches (async dispatch depth)
-                watchdog.pet()
+                # within log_step_interval dispatches (async dispatch depth).
+                # The FIRST dispatch return starts the watchdog instead: it
+                # includes the XLA compile, which must not count as a stall
+                # (--hang_timeout_s stays independent of compile time).
+                if watchdog.running:
+                    watchdog.pet()
+                else:
+                    watchdog.start()
             if prof["on"] and total_steps == prof_stop:
                 jax.device_get(metrics["loss"])  # fence (block_until_ready is
                 # a no-op on some PJRT transports, e.g. the axon tunnel)
@@ -283,6 +331,21 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                                      / max(steps_since_record, 1)),
                         grad_norm=float(jax.device_get(metrics["grad_norm"])))
                 steps_since_record = 0
+            if watchdog is not None and watchdog.escalation_requested():
+                # --hang_action checkpoint_exit: the watchdog flagged a hang
+                # (flag-then-poll like preempt.py — its thread must never
+                # touch device state); save a committed mid-epoch checkpoint
+                # and exit EXIT_HANG for the supervisor to restart. The
+                # acknowledge re-arms the watchdog's hard deadline so a save
+                # wedged on a truly dead device is still bounded.
+                watchdog.acknowledge_escalation()
+                master_print(f"watchdog escalation: saving emergency "
+                             f"checkpoint at epoch {epoch} (step {step + 1}) "
+                             f"and exiting with code {EXIT_HANG}")
+                jax.device_get(metrics["loss"])  # fence: step must be done
+                save_state(cfg.ckpt_dir, epoch, state, wait=True,
+                           step_in_epoch=step + 1)
+                raise SystemExit(EXIT_HANG)
             if _preempt_agreed(step_in_epoch=step):
                 # commit a synchronous save of the live mid-epoch state under
                 # this epoch's name (with the completed step count in the
