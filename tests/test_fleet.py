@@ -1,0 +1,1021 @@
+"""vitax.serve.fleet: replica rotation, least-loaded routing, admission.
+
+Fast tier pins the fleet behaviors against in-process fakes (stdlib HTTP
+stubs as replicas, injected spawn/clock/http_get for the manager — no jax,
+no subprocesses): least-loaded dispatch, ejection on failing /healthz,
+re-admission, one-retry-on-dispatch-failure, 429 + Retry-After under
+overload, fleet /metrics aggregation, plus the single-engine satellites
+(readiness split, bounded queue -> 503 queue_full, configurable request
+timeout, graceful drain). One `slow` e2e runs 2 real replicas from a
+2-step fake-data checkpoint, kills one mid-burst, and asserts zero
+client-visible errors, re-admission after the supervised restart, and
+clean SIGTERM drains (exit 0).
+"""
+
+import io
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from vitax.config import Config
+from vitax.serve.fleet import (
+    DEAD,
+    EJECTED,
+    READY,
+    STARTING,
+    AdmissionController,
+    ReplicaManager,
+    Router,
+    start_router,
+    stop_router,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        image_size=16, patch_size=8, embed_dim=32, num_heads=2, num_blocks=2,
+        num_classes=4, batch_size=16, dtype="float32", lr=1e-3, warmup_steps=2,
+        serve_max_batch=4, serve_topk=3, max_batch_wait_ms=10.0, seed=0,
+    )
+    base.update(kw)
+    return Config(**base).validate()
+
+
+def get_json(url: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def post_bytes(url: str, body: bytes, content_type: str = "image/png",
+               timeout: float = 60.0) -> dict:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": content_type})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def png_bytes(size: int = 16, seed: int = 0) -> bytes:
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 256, size=(size, size, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr, "RGB").save(buf, "PNG")
+    return buf.getvalue()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class DummyRecorder:
+    """Captures telemetry events: [(kind, payload), ...]."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **payload):
+        self.events.append((kind, payload))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+    def close(self):
+        pass
+
+
+class FakeReplica:
+    """In-process stand-in for one `python -m vitax.serve` replica: the same
+    three endpoints, with dials for every failure mode the fleet must
+    handle (dead healthz, ready: false, 500 predicts, queue-full 503,
+    slow predicts, held predicts)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.live = True            # False: /healthz answers 500
+        self.ready = True           # healthz "ready" field
+        self.fail_predicts = False  # /predict answers 500
+        self.bad_request = False    # /predict answers 400 (client's fault)
+        self.queue_full = False     # /predict answers 503 reason queue_full
+        self.latency_s = 0.0
+        self.hold = None            # Event: /predict blocks until set
+        self.predict_started = threading.Event()
+        self.predict_count = 0
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def _reply(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    if not fake.live:
+                        self._reply(500, {"error": "unhealthy"})
+                    else:
+                        self._reply(200, {"status": "ok",
+                                          "ready": fake.ready})
+                elif self.path == "/metrics":
+                    self._reply(200, {"requests_total": fake.predict_count,
+                                      "marker": fake.name})
+                else:
+                    self._reply(404, {})
+
+            def do_POST(self):  # noqa: N802
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if fake.queue_full:
+                    self._reply(503, {"error": "overloaded",
+                                      "reason": "queue_full"},
+                                headers={"Retry-After": "2"})
+                    return
+                if fake.bad_request:
+                    self._reply(400, {"error": "bad request: not an image"})
+                    return
+                if fake.fail_predicts:
+                    self._reply(500, {"error": "replica exploded"})
+                    return
+                fake.predict_started.set()
+                if fake.hold is not None:
+                    fake.hold.wait(timeout=30)
+                if fake.latency_s:
+                    time.sleep(fake.latency_s)
+                with fake._lock:
+                    fake.predict_count += 1
+                self._reply(200, {"classes": [1, 0, 2],
+                                  "probs": [0.5, 0.3, 0.2],
+                                  "latency_ms": 1.0,
+                                  "replica": fake.name})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def fleet_factory():
+    """Builds (manager, router, url, fakes) fleets over FakeReplicas and
+    tears everything down afterwards."""
+    cleanup = []
+
+    def build(n=2, admission=None, recorder=None, **manager_kw):
+        manager_kw.setdefault("fail_threshold", 2)
+        fakes = [FakeReplica("abcdefgh"[i]) for i in range(n)]
+        manager = ReplicaManager(recorder=recorder, **manager_kw)
+        for f in fakes:
+            manager.adopt(f.url, name=f.name)
+        manager.poll_once()  # admit everyone
+        router = Router(manager, admission=admission, recorder=recorder,
+                        request_timeout_s=10.0)
+        httpd = start_router(router, 0)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        cleanup.append((httpd, fakes))
+        return manager, router, url, fakes
+
+    yield build
+    for httpd, fakes in cleanup:
+        stop_router(httpd)
+        for f in fakes:
+            f.stop()
+
+
+# --- shared supervise seams ---------------------------------------------------
+
+
+def test_backoff_delay_sequence():
+    """The fleet restarts replicas on the exact capped-exponential schedule
+    vitax.supervise pins for training restarts (shared seam)."""
+    from vitax.supervise import backoff_delay
+    assert [backoff_delay(n, 1.0, 60.0) for n in range(1, 9)] == \
+        [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 60.0, 60.0]
+    assert backoff_delay(1, 0.5, 30.0) == 0.5
+    assert backoff_delay(2, 0.5, 30.0) == 1.0
+
+
+# --- admission control --------------------------------------------------------
+
+
+def test_admission_admits_before_first_observation():
+    a = AdmissionController(deadline_ms=100.0)
+    assert a.check(depth=50, ready_replicas=1) is None
+    assert a.admitted_total == 1 and a.shed_total == 0
+
+
+def test_admission_disabled_when_deadline_zero():
+    a = AdmissionController(deadline_ms=0.0)
+    a.observe(5.0)
+    assert a.check(depth=1000, ready_replicas=1) is None
+    assert a.shed_total == 0
+
+
+def test_admission_sheds_with_retry_after():
+    rec = DummyRecorder()
+    a = AdmissionController(deadline_ms=100.0, recorder=rec)
+    a.observe(1.0)  # EWMA service time 1s
+    # predicted wait = 3 * 1.0 / 2 = 1.5s > 0.1s deadline -> shed,
+    # Retry-After = ceil(1.5 - 0.1) = 2
+    assert a.check(depth=3, ready_replicas=2) == 2
+    assert a.shed_total == 1
+    kind, payload = rec.events[-1]
+    assert kind == "admission" and payload["decision"] == "shed"
+    assert payload["retry_after_s"] == 2
+    # empty fleet queue admits (predicted 0)
+    assert a.check(depth=0, ready_replicas=2) is None
+    # more replicas absorb the same depth
+    a2 = AdmissionController(deadline_ms=600.0)
+    a2.observe(1.0)
+    assert a2.check(depth=1, ready_replicas=2) is None   # 0.5s <= 0.6s
+    assert a2.check(depth=4, ready_replicas=2) is not None  # 2.0s > 0.6s
+
+
+def test_admission_ewma_and_record_shed():
+    a = AdmissionController(deadline_ms=100.0, ewma_alpha=0.2)
+    a.observe(1.0)
+    a.observe(0.0)
+    assert abs(a.ewma_service_s - 0.8) < 1e-9
+    rec_before = a.shed_total
+    a.record_shed(reason="replica_queue_full", replica="a")
+    assert a.shed_total == rec_before + 1
+    snap = a.snapshot()
+    assert snap["shed_total"] == a.shed_total
+    assert snap["deadline_ms"] == 100.0
+
+
+# --- replica manager (injected seams; no sockets, no processes) ---------------
+
+
+def _never(url, timeout):
+    raise ConnectionError("unreachable")
+
+
+def test_manager_acquire_least_loaded_and_release_accounting():
+    m = ReplicaManager(http_get=_never)
+    a = m.adopt("http://a", name="a")
+    b = m.adopt("http://b", name="b")
+    a.state = b.state = READY
+    a.ewma_latency_s, b.ewma_latency_s = 0.5, 0.1
+    # tie on in_flight (0) -> lower EWMA wins
+    assert m.acquire() is b and b.in_flight == 1
+    # now a is least-loaded
+    assert m.acquire() is a
+    # exclusion (the one-retry path) skips a
+    assert m.acquire(exclude={"a"}) is b and b.in_flight == 2
+    assert m.total_in_flight() == 3
+    # successful release: EWMA folds in, counters move
+    m.release(b, latency_s=0.3, ok=True)
+    assert b.in_flight == 1 and b.requests_total == 1
+    assert abs(b.ewma_latency_s - (0.2 * 0.3 + 0.8 * 0.1)) < 1e-9
+    # failed release: no EWMA pollution, failure counted
+    m.release(a, ok=False)
+    assert a.dispatch_failures == 1 and a.requests_total == 0
+    assert a.ewma_latency_s == 0.5
+    # first observation seeds the EWMA directly
+    m.release(b, latency_s=0.2, ok=True)
+    c = m.adopt("http://c", name="c")
+    c.state = READY
+    got = m.acquire(exclude={"a", "b"})
+    m.release(got, latency_s=0.7, ok=True)
+    assert c.ewma_latency_s == 0.7
+    # nothing READY -> None
+    a.state = b.state = c.state = EJECTED
+    assert m.acquire() is None
+
+
+def test_manager_eject_and_readmit_via_healthz():
+    rec = DummyRecorder()
+    state = {"resp": {"status": "ok", "ready": True}}
+
+    def http_get(url, timeout):
+        if isinstance(state["resp"], Exception):
+            raise state["resp"]
+        return state["resp"]
+
+    m = ReplicaManager(recorder=rec, http_get=http_get, fail_threshold=2)
+    r = m.adopt("http://a", name="a")
+    assert r.state == STARTING
+    m.poll_once()
+    assert r.state == READY
+    # one failed poll tolerated (fail_threshold=2), second ejects
+    state["resp"] = ConnectionError("down")
+    m.poll_once()
+    assert r.state == READY and r.health_failures == 1
+    m.poll_once()
+    assert r.state == EJECTED
+    # live but warming/draining (ready: false) stays out of rotation and
+    # does NOT count as a health failure
+    state["resp"] = {"status": "ok", "ready": False}
+    m.poll_once()
+    assert r.state == EJECTED and r.health_failures == 0
+    # recovered: re-admitted
+    state["resp"] = {"status": "ok", "ready": True}
+    m.poll_once()
+    assert r.state == READY
+    kinds = rec.kinds()
+    assert kinds.count("replica_eject") == 1
+    assert kinds.count("replica_admit") == 2  # initial admit + re-admit
+
+
+def test_manager_ready_not_ready_ejects_ready_replica():
+    """A READY replica reporting ready: false (it started draining) is
+    ejected immediately — not after fail_threshold polls."""
+    rec = DummyRecorder()
+    state = {"resp": {"status": "ok", "ready": True}}
+    m = ReplicaManager(recorder=rec, fail_threshold=5,
+                       http_get=lambda url, t: state["resp"])
+    r = m.adopt("http://a", name="a")
+    m.poll_once()
+    assert r.state == READY
+    state["resp"] = {"status": "ok", "ready": False}
+    m.poll_once()
+    assert r.state == EJECTED
+    assert ("replica_eject", {"replica": "a", "reason": "not_ready"}) \
+        in rec.events
+
+
+class FakeProc:
+    """Popen stand-in with a settable return code."""
+
+    def __init__(self):
+        self.rc = None
+        self.signals = []
+
+    def poll(self):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        self.rc = 0
+
+    def kill(self):
+        self.rc = -9
+
+
+def test_manager_restarts_dead_replica_with_backoff():
+    rec = DummyRecorder()
+    spawned = []
+
+    def spawn(argv):
+        p = FakeProc()
+        spawned.append(p)
+        return p
+
+    m = ReplicaManager(recorder=rec, spawn=spawn, http_get=_never,
+                       backoff_s=0.5, backoff_max_s=30.0, max_restarts=2,
+                       clock=lambda: 0.0)
+    r = m.manage(["serve", "cmd"], "http://a", name="a")
+    assert len(spawned) == 1 and r.managed
+    # death -> DEAD immediately, respawn gated behind backoff_delay(1)=0.5s
+    spawned[0].rc = 1
+    m.poll_once(now=100.0)
+    assert r.state == DEAD and r.exit_code == 1
+    m.poll_once(now=100.2)
+    assert len(spawned) == 1  # still inside the backoff window
+    m.poll_once(now=100.6)
+    assert len(spawned) == 2 and r.state == STARTING
+    assert r.restarts == 1 and m.restart_total == 1
+    # second death -> backoff doubles to 1.0s
+    spawned[1].rc = -9
+    m.poll_once(now=200.0)
+    assert r.state == DEAD
+    m.poll_once(now=200.7)
+    assert len(spawned) == 2
+    m.poll_once(now=201.1)
+    assert len(spawned) == 3 and r.restarts == 2
+    # max_restarts=2 exhausted: a third death is final
+    spawned[2].rc = 1
+    m.poll_once(now=300.0)
+    m.poll_once(now=400.0)
+    assert len(spawned) == 3 and r.state == DEAD
+    kinds = rec.kinds()
+    assert kinds.count("replica_spawn") == 1
+    assert kinds.count("replica_exit") == 3
+    assert kinds.count("replica_restart") == 2
+
+
+def test_manager_adopted_replicas_are_never_restarted():
+    spawned = []
+    m = ReplicaManager(spawn=lambda argv: spawned.append(argv),
+                       http_get=_never)
+    r = m.adopt("http://a", name="a")
+    assert not r.managed
+    for now in (0.0, 10.0, 1000.0):
+        m.poll_once(now=now)
+    assert spawned == []
+
+
+# --- fleet CLI argv plumbing ---------------------------------------------------
+
+
+def test_strip_flags_and_replica_argv():
+    from vitax.serve.fleet.__main__ import (
+        _FLEET_ONLY_FLAGS, replica_argv, strip_flags)
+    argv = ["--replicas", "3", "--embed_dim", "32", "--slo_p99_ms=250",
+            "--serve_port", "8000", "--metrics_dir=/m", "--fake_data",
+            "--base_port", "9000"]
+    assert strip_flags(argv, _FLEET_ONLY_FLAGS) == \
+        ["--embed_dim", "32", "--fake_data"]
+    child = replica_argv(argv, 8101, metrics_dir="/m/replica_1")
+    assert child[:3] == [sys.executable, "-m", "vitax.serve"]
+    assert "--replicas" not in child and "--slo_p99_ms" not in child
+    i = child.index("--serve_port")
+    assert child[i + 1] == "8101"
+    j = child.index("--metrics_dir")
+    assert child[j + 1] == "/m/replica_1"
+    # no per-replica metrics dir -> flag not re-issued
+    assert "--metrics_dir" not in replica_argv(argv, 8102)
+
+
+# --- router over fake replicas --------------------------------------------------
+
+
+def test_router_round_trip_healthz_and_404(fleet_factory):
+    manager, router, url, fakes = fleet_factory(n=2)
+    resp = post_bytes(url + "/predict", b"anything",
+                      content_type="application/octet-stream")
+    assert resp["classes"] == [1, 0, 2]
+    assert resp["replica"] in ("a", "b")
+    health = get_json(url + "/healthz")
+    assert health["status"] == "ok" and health["ready"] is True
+    assert health["replicas"] == {"a": READY, "b": READY}
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get_json(url + "/nope")
+    assert e.value.code == 404
+
+
+def test_router_least_loaded_dispatch(fleet_factory):
+    manager, router, url, fakes = fleet_factory(n=2)
+    a, b = fakes
+    a.hold = threading.Event()  # a's next predict blocks
+    held = threading.Thread(
+        target=lambda: post_bytes(url + "/predict", b"x"), daemon=True)
+    held.start()
+    assert a.predict_started.wait(timeout=10)  # the first pick is a
+    # with a busy (in_flight 1), the next request must go to b
+    resp = post_bytes(url + "/predict", b"y")
+    assert resp["replica"] == "b"
+    a.hold.set()
+    held.join(timeout=10)
+    assert a.predict_count == 1 and b.predict_count == 1
+    assert manager.total_in_flight() == 0  # every acquire was released
+
+
+def test_router_ejection_and_readmission(fleet_factory):
+    rec = DummyRecorder()
+    manager, router, url, fakes = fleet_factory(n=2, recorder=rec)
+    a, b = fakes
+    a.live = False
+    manager.poll_once()
+    manager.poll_once()  # fail_threshold=2
+    assert manager.ready_count() == 1
+    assert get_json(url + "/healthz")["replicas"]["a"] == EJECTED
+    # every dispatch lands on b while a is out of rotation
+    for _ in range(4):
+        assert post_bytes(url + "/predict", b"x")["replica"] == "b"
+    # recovery: one live-and-ready poll re-admits
+    a.live = True
+    manager.poll_once()
+    assert manager.ready_count() == 2
+    # a is cold (in_flight 0, no EWMA) so the next pick is a
+    assert post_bytes(url + "/predict", b"x")["replica"] == "a"
+    assert "replica_eject" in rec.kinds() and "replica_admit" in rec.kinds()
+
+
+def test_router_one_retry_on_dispatch_failure(fleet_factory):
+    manager, router, url, fakes = fleet_factory(n=2)
+    a, b = fakes
+    a.fail_predicts = True  # both idle -> a is picked first (list order)
+    resp = post_bytes(url + "/predict", b"x")
+    assert resp["replica"] == "b"  # retried on the other replica
+    assert router.metrics.retries_total == 1
+    assert manager.replicas[0].dispatch_failures == 1
+    # both failing -> 503 dispatch_failed (one retry, not an infinite loop)
+    b.fail_predicts = True
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/predict", b"x")
+    assert e.value.code == 503
+    assert json.load(e.value)["reason"] == "dispatch_failed"
+    assert manager.total_in_flight() == 0
+
+
+def test_router_503_when_no_ready_replicas(fleet_factory):
+    manager, router, url, fakes = fleet_factory(n=1)
+    fakes[0].live = False
+    manager.poll_once()
+    manager.poll_once()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/predict", b"x")
+    assert e.value.code == 503
+    assert json.load(e.value)["reason"] == "no_ready_replicas"
+
+
+def test_router_admission_shed_429_with_retry_after(fleet_factory):
+    rec = DummyRecorder()
+    admission = AdmissionController(deadline_ms=100.0, recorder=rec)
+    manager, router, url, fakes = fleet_factory(n=2, admission=admission)
+    admission.observe(1.0)  # slow fleet: EWMA service 1s
+    # fake a deep queue: 3 in flight over 2 replicas -> predicted 1.5s
+    for _ in range(3):
+        manager.acquire()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/predict", b"x")
+    assert e.value.code == 429
+    assert int(e.value.headers["Retry-After"]) >= 1
+    assert json.load(e.value)["reason"] == "admission"
+    assert admission.shed_total == 1 and router.metrics.shed_total == 1
+    assert "admission" in rec.kinds()
+    # the shed never reached a replica
+    assert fakes[0].predict_count == 0 and fakes[1].predict_count == 0
+
+
+def test_router_maps_replica_queue_full_to_429(fleet_factory):
+    admission = AdmissionController(deadline_ms=0.0)  # shedding off
+    manager, router, url, fakes = fleet_factory(n=1, admission=admission)
+    fakes[0].queue_full = True
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/predict", b"x")
+    assert e.value.code == 429
+    # the replica's own Retry-After passes through
+    assert e.value.headers["Retry-After"] == "2"
+    assert json.load(e.value)["reason"] == "replica_queue_full"
+    assert admission.shed_total == 1  # counted in fleet shed accounting
+
+
+def test_router_passes_client_errors_through(fleet_factory):
+    """A replica 4xx is the client's fault: passed through verbatim, never
+    retried on another replica (a retry would just fail the same way)."""
+    manager, router, url, fakes = fleet_factory(n=2)
+    a, b = fakes
+    a.bad_request = True  # both idle -> a is picked first (list order)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/predict", b"not an image")
+    assert e.value.code == 400
+    assert "bad request" in json.load(e.value)["error"]
+    assert b.predict_count == 0  # never retried elsewhere
+    assert router.metrics.retries_total == 0
+    assert router.metrics.errors_total == 1
+    assert manager.total_in_flight() == 0
+
+
+def test_fleet_metrics_aggregation(fleet_factory):
+    admission = AdmissionController(deadline_ms=500.0)
+    manager, router, url, fakes = fleet_factory(n=2, admission=admission)
+    for i in range(6):
+        post_bytes(url + "/predict", b"x")
+    snap = get_json(url + "/metrics")
+    assert snap["requests_total"] == 6 and snap["errors_total"] == 0
+    for key in ("latency_s_p50", "latency_s_p95", "latency_s_p99"):
+        assert snap[key] is not None and snap[key] > 0
+    assert snap["fleet"] == {"size": 2, "ready": 2, "in_flight": 0,
+                             "replica_restarts": 0}
+    assert set(snap["replicas"]) == {"a", "b"}
+    total = 0
+    for name, rsnap in snap["replicas"].items():
+        assert rsnap["state"] == READY
+        assert rsnap["server"]["marker"] == name  # replica /metrics folded in
+        total += rsnap["requests_total"]
+    assert total == 6
+    assert snap["admission"]["admitted_total"] == 6
+    assert snap["request_timeout_s"] == 10.0
+
+
+def test_overload_drill_bounded_and_contractual(fleet_factory):
+    """Under sustained overload every answer is 200 or 429-with-Retry-After,
+    the fleet's in-flight depth stays bounded by the client concurrency,
+    and no successful request waits unboundedly."""
+    admission = AdmissionController(deadline_ms=1.0)  # brutal deadline
+    manager, router, url, fakes = fleet_factory(n=1, admission=admission)
+    fakes[0].latency_s = 0.05
+    post_bytes(url + "/predict", b"seed")  # seed the admission EWMA
+    codes, latencies = [], []
+    depth_samples = []
+    lock = threading.Lock()
+    stop_sampling = threading.Event()
+
+    def sampler():
+        while not stop_sampling.wait(timeout=0.01):
+            depth_samples.append(manager.total_in_flight())
+
+    def worker():
+        for _ in range(3):
+            t0 = time.time()
+            try:
+                post_bytes(url + "/predict", b"x", timeout=30)
+                code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+                assert e.headers.get("Retry-After") is not None
+            with lock:
+                codes.append(code)
+                latencies.append(time.time() - t0)
+
+    threading.Thread(target=sampler, daemon=True).start()
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    stop_sampling.set()
+    assert len(codes) == 12
+    assert set(codes) <= {200, 429}
+    assert 429 in codes  # the drill actually overloaded
+    assert max(depth_samples, default=0) <= 4  # bounded by concurrency
+    assert all(dt < 30 for dt in latencies)  # nothing waited out the timeout
+    assert admission.shed_total == codes.count(429)
+
+
+# --- single-engine satellites (real server, fake engine) -----------------------
+
+
+class FakeEngine:
+    """InferenceEngine stand-in: same surface the server/batcher touch."""
+
+    def __init__(self, delay_s=0.0):
+        self.buckets = (1, 2, 4)
+        self.topk = 3
+        self.compile_count = 3
+        self.ready = True
+        self.delay_s = delay_s
+        self.hold = None
+        self.predict_started = threading.Event()
+
+    def predict(self, images):
+        self.predict_started.set()
+        if self.hold is not None:
+            self.hold.wait(timeout=30)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        n = images.shape[0]
+        return (np.tile(np.arange(3, dtype=np.int32), (n, 1)),
+                np.tile(np.array([0.5, 0.3, 0.2], np.float32), (n, 1)))
+
+
+def _start(cfg, engine):
+    from vitax.serve import start_server
+    httpd, ctx = start_server(cfg, engine, port=0)
+    return httpd, ctx, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_server_not_ready_until_warmup():
+    from vitax.serve import stop_server
+    engine = FakeEngine()
+    engine.ready = False  # pre-warmup
+    httpd, ctx, url = _start(tiny_cfg(), engine)
+    try:
+        health = get_json(url + "/healthz")
+        assert health["status"] == "ok"   # live the moment it binds
+        assert health["ready"] is False   # but not routable
+        assert health["draining"] is False
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post_bytes(url + "/predict", png_bytes())
+        assert e.value.code == 503
+        body = json.load(e.value)
+        assert body["reason"] == "warming_up"
+        assert e.value.headers["Retry-After"] == "1"
+        # warmup completes -> ready flips, traffic flows
+        engine.ready = True
+        assert get_json(url + "/healthz")["ready"] is True
+        resp = post_bytes(url + "/predict", png_bytes())
+        assert len(resp["classes"]) == 3
+        assert get_json(url + "/metrics")["ready"] is True
+    finally:
+        stop_server(httpd, ctx)
+
+
+def test_server_queue_full_503_then_recovers():
+    from vitax.serve import stop_server
+    engine = FakeEngine()
+    engine.hold = threading.Event()
+    cfg = tiny_cfg(serve_max_batch=1, serve_queue_max=1,
+                   max_batch_wait_ms=1.0)
+    httpd, ctx, url = _start(cfg, engine)
+    results, errors = [], []
+
+    def bg():
+        try:
+            results.append(post_bytes(url + "/predict", png_bytes()))
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    try:
+        t1 = threading.Thread(target=bg)
+        t1.start()
+        assert engine.predict_started.wait(timeout=10)  # r1 inside predict
+        t2 = threading.Thread(target=bg)
+        t2.start()
+        deadline = time.time() + 10
+        while ctx.batcher.queue_depth() < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert ctx.batcher.queue_depth() == 1  # r2 queued, queue now full
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post_bytes(url + "/predict", png_bytes())
+        assert e.value.code == 503
+        body = json.load(e.value)
+        assert body["reason"] == "queue_full"
+        assert "serve_queue_max" in body["error"]
+        assert e.value.headers["Retry-After"] == "1"
+        # recovery: unblock the engine, everything queued answers, and new
+        # requests are admitted again
+        engine.hold.set()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        assert not errors and len(results) == 2
+        resp = post_bytes(url + "/predict", png_bytes())
+        assert len(resp["classes"]) == 3
+    finally:
+        engine.hold.set()
+        stop_server(httpd, ctx)
+
+
+def test_server_request_timeout_configurable():
+    from vitax.serve import stop_server
+    engine = FakeEngine(delay_s=1.0)  # slower than the timeout below
+    cfg = tiny_cfg(serve_request_timeout_s=0.2)
+    httpd, ctx, url = _start(cfg, engine)
+    try:
+        assert get_json(url + "/metrics")["request_timeout_s"] == 0.2
+        t0 = time.time()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post_bytes(url + "/predict", png_bytes())
+        assert e.value.code == 503
+        assert "inference failed" in json.load(e.value)["error"]
+        assert time.time() - t0 < 5.0  # answered at the timeout, not at 60s
+    finally:
+        stop_server(httpd, ctx)
+
+
+def test_server_graceful_drain_answers_inflight():
+    from vitax.serve import drain
+    engine = FakeEngine()
+    engine.hold = threading.Event()
+    httpd, ctx, url = _start(tiny_cfg(), engine)
+    results = []
+    t1 = threading.Thread(
+        target=lambda: results.append(post_bytes(url + "/predict",
+                                                 png_bytes())))
+    t1.start()
+    assert engine.predict_started.wait(timeout=10)
+    assert ctx.inflight() == 1
+    # draining flips readiness off: new requests are refused while the
+    # in-flight one is still being answered
+    with ctx._flight_cond:
+        ctx.draining = True
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post_bytes(url + "/predict", png_bytes())
+    assert e.value.code == 503
+    assert json.load(e.value)["reason"] == "draining"
+    # release the engine just after drain starts waiting
+    threading.Timer(0.2, engine.hold.set).start()
+    assert drain(httpd, ctx, timeout_s=30.0) is True  # drained clean
+    t1.join(timeout=10)
+    assert len(results) == 1  # the accepted request WAS answered
+    assert len(results[0]["classes"]) == 3
+
+
+# --- config validation (satellite) ---------------------------------------------
+
+
+@pytest.mark.parametrize("kw,match", [
+    (dict(serve_queue_max=-1), "serve_queue_max"),
+    (dict(serve_request_timeout_s=0.0), "serve_request_timeout_s"),
+    (dict(serve_request_timeout_s=-5.0), "serve_request_timeout_s"),
+])
+def test_config_fleet_validation_rejects(kw, match):
+    with pytest.raises(AssertionError, match=match):
+        tiny_cfg(**kw)
+
+
+def test_config_fleet_defaults():
+    cfg = Config().validate()
+    assert cfg.serve_queue_max == 1024
+    assert cfg.serve_request_timeout_s == 60.0
+
+
+def test_batcher_queue_full_typed_and_recovers():
+    from vitax.serve import DynamicBatcher, QueueFull
+    release = threading.Event()
+    started = threading.Event()
+
+    def predict(images):
+        started.set()
+        release.wait(timeout=30)
+        n = images.shape[0]
+        return (np.zeros((n, 3), np.int32), np.zeros((n, 3), np.float32))
+
+    b = DynamicBatcher(predict, max_batch=1, max_wait_ms=1.0, queue_max=1)
+    try:
+        f1 = b.submit(np.zeros((4, 4, 3), np.uint8))
+        assert started.wait(timeout=10)  # worker busy on f1
+        f2 = b.submit(np.zeros((4, 4, 3), np.uint8))  # fills the queue
+        with pytest.raises(QueueFull, match="serve_queue_max"):
+            b.submit(np.zeros((4, 4, 3), np.uint8))
+        release.set()
+        assert f1.result(timeout=30).batch_size == 1
+        assert f2.result(timeout=30).batch_size == 1
+        # queue drained: submissions flow again
+        assert b.submit(np.zeros((4, 4, 3), np.uint8)).result(
+            timeout=30) is not None
+    finally:
+        release.set()
+        b.close()
+
+
+# --- serve_bench fleet contract --------------------------------------------------
+
+
+def _import_tool(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_serve_bench_counts_sheds_separately():
+    """429s are contract behavior: counted as sheds, not errors, and the
+    worker honors Retry-After."""
+    serve_bench = _import_tool("serve_bench")
+
+    class Shedder(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: A003
+            pass
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = b'{"error": "shed", "reason": "admission"}'
+            self.send_response(429)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Retry-After", "0")
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Shedder)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        summary = serve_bench.run_bench(
+            url, concurrency=2, requests_per_worker=2, image_size=16,
+            timeout=10.0, slo_p99_ms=100.0)
+        assert summary["shed"] == 4 and summary["errors"] == 0
+        assert summary["completed"] == 0
+        assert summary["shed_fraction"] == 1.0
+        assert summary["slo"]["attained"] is False  # nothing completed
+        json.dumps(summary)  # --json stays one serializable object
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_serve_bench_fleet_slo_report(fleet_factory):
+    """run_bench against a 2-replica fleet: SLO verdict + rotation report
+    from the router's /metrics."""
+    serve_bench = _import_tool("serve_bench")
+    manager, router, url, fakes = fleet_factory(n=2)
+    summary = serve_bench.run_bench(
+        url, concurrency=4, requests_per_worker=3, image_size=16,
+        timeout=30.0, target_rps=100.0, slo_p99_ms=5000.0, replicas=2)
+    assert summary["completed"] == 12 and summary["errors"] == 0
+    assert summary["shed"] == 0
+    assert summary["slo"]["attained"] is True
+    assert summary["fleet"]["replicas"] == 2
+    assert summary["fleet"]["ready_end"] == 2
+    assert summary["fleet"]["ready_min"] == 2
+    assert summary["fleet"]["replica_restarts"] == 0
+    assert summary["achieved_rps"] > 0
+    # both replicas actually served (least-loaded spreads a 4-way burst)
+    assert fakes[0].predict_count > 0 and fakes[1].predict_count > 0
+
+
+def test_metrics_report_fleet_counters(tmp_path):
+    """tools/metrics_report.py --json surfaces admission sheds and replica
+    restarts out of serve.jsonl."""
+    metrics_report = _import_tool("metrics_report")
+    path = tmp_path / "serve.jsonl"
+    records = [
+        {"schema": 1, "time": 1.0, "kind": "admission", "decision": "shed"},
+        {"schema": 1, "time": 2.0, "kind": "admission", "decision": "shed"},
+        {"schema": 1, "time": 3.0, "kind": "replica_restart", "replica": "a",
+         "restart": 1},
+        {"schema": 1, "time": 4.0, "kind": "serve_request", "latency_s": 0.1},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    summary = metrics_report.summarize(str(path))
+    assert summary["admission_shed_count"] == 2
+    assert summary["replica_restarts"] == 1
+
+
+# --- e2e: real replicas, kill one mid-burst (slow) --------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_e2e_kill_replica_zero_client_errors(devices8,
+                                                   tmp_path_factory):
+    """2 real `python -m vitax.serve` replicas from a 2-step fake-data
+    checkpoint behind the router; SIGKILL one mid-burst. Zero
+    client-visible errors (one-retry hides the death), the supervised
+    restart re-warms and re-admits it, and manager.stop() SIGTERM-drains
+    both replicas to exit 0."""
+    from vitax.train.loop import train
+
+    root = tmp_path_factory.mktemp("fleet_e2e")
+    ckpt_dir = str(root / "ckpt")
+    cfg = tiny_cfg(fake_data=True, num_epochs=1, steps_per_epoch=2,
+                   log_step_interval=1, ckpt_dir=ckpt_dir,
+                   ckpt_epoch_interval=1, num_workers=2, eval_max_batches=1)
+    train(cfg)
+    assert os.path.isdir(os.path.join(ckpt_dir, "epoch_1"))
+
+    model_flags = [
+        "--image_size", "16", "--patch_size", "8", "--embed_dim", "32",
+        "--num_heads", "2", "--num_blocks", "2", "--num_classes", "4",
+        "--dtype", "float32", "--serve_max_batch", "4", "--serve_topk", "3",
+        "--max_batch_wait_ms", "10.0", "--ckpt_dir", ckpt_dir,
+        "--epoch", "1",
+    ]
+    manager = ReplicaManager(health_interval_s=0.25, backoff_s=0.5)
+    httpd = None
+    try:
+        for i in range(2):
+            port = free_port()
+            argv = ([sys.executable, "-m", "vitax.serve"] + model_flags
+                    + ["--serve_port", str(port)])
+            manager.manage(argv, f"http://127.0.0.1:{port}",
+                           name=f"replica_{i}")
+        manager.start()
+        deadline = time.time() + 300
+        while manager.ready_count() < 2 and time.time() < deadline:
+            time.sleep(0.5)
+        assert manager.ready_count() == 2, manager.snapshot()
+
+        router = Router(manager, request_timeout_s=60.0)
+        httpd = start_router(router, 0)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        body = png_bytes(16, seed=4)
+        results, errors, lock = [], [], threading.Lock()
+
+        def worker():
+            for _ in range(4):
+                try:
+                    r = post_bytes(url + "/predict", body, timeout=90)
+                    with lock:
+                        results.append(r)
+                except Exception as e:  # noqa: BLE001 — any error fails the drill
+                    with lock:
+                        errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        manager.replicas[0].proc.kill()  # SIGKILL mid-burst
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == 16  # zero client-visible errors
+
+        # the health loop restarts + re-warms + re-admits the dead replica
+        deadline = time.time() + 300
+        while time.time() < deadline and not (
+                manager.ready_count() == 2 and manager.restart_total >= 1):
+            time.sleep(0.5)
+        assert manager.restart_total >= 1
+        assert manager.ready_count() == 2, manager.snapshot()
+        resp = post_bytes(url + "/predict", body, timeout=90)
+        assert len(resp["classes"]) == 3
+    finally:
+        if httpd is not None:
+            stop_router(httpd)
+        manager.stop()  # SIGTERM drain
+        for r in manager.replicas:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.kill()
+    # the graceful-drain contract: SIGTERM -> in-flight answered -> exit 0
+    for r in manager.replicas:
+        assert r.exit_code == 0, manager.snapshot()

@@ -13,6 +13,7 @@ cd "$(dirname "$0")/.."
 # tree (a rename that drops them out of coverage should fail loudly)
 for path in vitax/telemetry tools/metrics_report.py \
             vitax/serve tools/serve_bench.py tests/test_serve.py \
+            vitax/serve/fleet tests/test_fleet.py \
             vitax/analysis tools/check_invariants.py tests/test_analysis.py \
             vitax/faults.py vitax/supervise.py tools/supervise.py \
             tests/test_faults.py; do

@@ -91,6 +91,12 @@ def summarize(path: str) -> dict:
         "fault_events": sum(1 for e in events if e.get("kind") == "fault"),
         "hang_escalations": sum(1 for e in events
                                 if e.get("kind") == "hang_escalation"),
+        # fleet serving (vitax/serve/fleet/ writes these into serve.jsonl —
+        # point this report at it for the overload/rotation story)
+        "admission_shed_count": sum(1 for e in events
+                                    if e.get("kind") == "admission"),
+        "replica_restarts": sum(1 for e in events
+                                if e.get("kind") == "replica_restart"),
     }
     # supervisor restarts (vitax/supervise.py appends these between child
     # runs, so they interleave with the child's own records)
@@ -152,6 +158,10 @@ def print_human(summary: dict) -> None:
     if summary.get("restart_count"):
         print(f"  !! supervisor restarts: {summary['restart_count']} "
               f"(last child exit code {summary['last_exit_code']})")
+    if summary.get("admission_shed_count"):
+        print(f"  admission sheds (429): {summary['admission_shed_count']}")
+    if summary.get("replica_restarts"):
+        print(f"  !! fleet replica restarts: {summary['replica_restarts']}")
     ev = summary.get("eval_last")
     if ev:
         print(f"  eval (epoch {ev['epoch']}): top1 {ev['top1']:.4f}  "

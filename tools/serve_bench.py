@@ -13,8 +13,22 @@ batch occupancy) for the same window.
         --concurrency 8 --requests 200 --image_size 224
     python tools/serve_bench.py ... --serve_jsonl /runs/s/serve.jsonl --json
 
+Fleet mode (target = a vitax.serve.fleet router):
+- `--target_rps N` paces the closed loop to an offered rate (each worker
+  sleeps out the remainder of its share of 1/N between requests) so the
+  bench exercises an SLO contract instead of saturating;
+- 429 responses (admission sheds) are counted separately from errors —
+  they ARE the overload contract — and the worker honors Retry-After
+  (capped at 1s so benches stay short);
+- `--slo_p99_ms D` adds an SLO verdict to the summary: attained iff the
+  client p99 of successful requests is within D and errors == 0;
+- `--replicas N` samples the router's /metrics during the run and reports
+  rotation (ready_min/ready_end) and replica_restarts — a kill-a-replica
+  drill shows up here, not in the error count.
+
 stdlib-only (urllib + threading): the bench must run on bare CI hosts.
-Exit status: 0 when every request succeeded, 2 otherwise.
+Exit status: 0 when every request succeeded (sheds are not errors),
+2 otherwise.
 """
 
 from __future__ import annotations
@@ -55,7 +69,12 @@ def make_image_bytes(image_size: int, seed: int = 0) -> bytes:
 
 
 def run_worker(url: str, body: bytes, n_requests: int, timeout: float,
-               latencies: list, errors: list, lock: threading.Lock) -> None:
+               latencies: list, errors: list, lock: threading.Lock,
+               sheds: list = None, interval_s: float = 0.0) -> None:
+    """One closed-loop worker. `interval_s` > 0 paces to an offered rate
+    (open-ish loop: sleep out the remainder of the interval after each
+    response); `sheds` collects 429 admission responses separately from
+    errors — shedding under overload is contract behavior, not failure."""
     for _ in range(n_requests):
         req = urllib.request.Request(
             url + "/predict", data=body,
@@ -67,9 +86,77 @@ def run_worker(url: str, body: bytes, n_requests: int, timeout: float,
                 assert "classes" in payload and "probs" in payload
             with lock:
                 latencies.append(time.time() - t0)
+        except urllib.error.HTTPError as e:
+            if e.code == 429 and sheds is not None:
+                retry_after = 1.0
+                try:
+                    retry_after = float(e.headers.get("Retry-After", "1"))
+                except (TypeError, ValueError):
+                    pass
+                with lock:
+                    sheds.append(retry_after)
+                time.sleep(min(max(retry_after, 0.0), 1.0))
+            else:
+                with lock:
+                    errors.append(f"HTTPError: {e.code}")
         except Exception as e:  # noqa: BLE001 — count, keep loading
             with lock:
                 errors.append(f"{type(e).__name__}: {e}")
+        if interval_s > 0:
+            leftover = interval_s - (time.time() - t0)
+            if leftover > 0:
+                time.sleep(leftover)
+
+
+class FleetSampler:
+    """Polls the router's GET /metrics during the bench to observe rotation:
+    minimum ready count seen (did the fleet lose replicas?), final ready
+    count (did they come back?), and restarts performed."""
+
+    def __init__(self, url: str, period_s: float = 0.5):
+        self.url = url
+        self.period_s = period_s
+        self.ready_min = None
+        self.ready_end = None
+        self.fleet_size = None
+        self.restarts_end = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _sample(self) -> None:
+        try:
+            with urllib.request.urlopen(self.url + "/metrics",
+                                        timeout=5.0) as resp:
+                snap = json.load(resp)
+        except Exception:  # noqa: BLE001 — sampling is best-effort
+            return
+        fleet = snap.get("fleet") or {}
+        ready = fleet.get("ready")
+        if ready is not None:
+            self.ready_end = ready
+            self.ready_min = (ready if self.ready_min is None
+                              else min(self.ready_min, ready))
+        self.fleet_size = fleet.get("size", self.fleet_size)
+        self.restarts_end = fleet.get("replica_restarts", self.restarts_end)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.period_s):
+            self._sample()
+
+    def start(self) -> None:
+        self._sample()
+        self._thread.start()
+
+    def stop(self) -> dict:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._sample()
+        return {
+            "replicas": self.fleet_size,
+            "ready_min": self.ready_min,
+            "ready_end": self.ready_end,
+            "replica_restarts": self.restarts_end,
+        }
 
 
 def summarize_serve_jsonl(path: str, since: float) -> dict:
@@ -112,17 +199,24 @@ def summarize_serve_jsonl(path: str, since: float) -> dict:
 
 
 def run_bench(url: str, concurrency: int, requests_per_worker: int,
-              image_size: int, timeout: float,
-              serve_jsonl: str = "") -> dict:
+              image_size: int, timeout: float, serve_jsonl: str = "",
+              target_rps: float = 0.0, slo_p99_ms: float = 0.0,
+              replicas: int = 0) -> dict:
     body = make_image_bytes(image_size)
     latencies: list = []
     errors: list = []
+    sheds: list = []
     lock = threading.Lock()
+    # pacing: each of C workers owns 1/C of the offered rate
+    interval_s = concurrency / target_rps if target_rps > 0 else 0.0
+    sampler = FleetSampler(url) if replicas > 0 else None
+    if sampler is not None:
+        sampler.start()
     t_start = time.time()
     workers = [threading.Thread(
         target=run_worker,
         args=(url, body, requests_per_worker, timeout, latencies, errors,
-              lock), daemon=True)
+              lock, sheds, interval_s), daemon=True)
         for _ in range(concurrency)]
     for w in workers:
         w.start()
@@ -137,13 +231,29 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
         "completed": len(lat),
         "errors": len(errors),
         "error_samples": errors[:3],
+        "shed": len(sheds),
+        "shed_fraction": round(
+            len(sheds) / max(concurrency * requests_per_worker, 1), 4),
         "elapsed_s": round(elapsed, 3),
         "throughput_rps": round(len(lat) / max(elapsed, 1e-9), 3),
+        "achieved_rps": round(
+            (len(lat) + len(sheds)) / max(elapsed, 1e-9), 3),
         "latency_s_p50": percentile(lat, 0.50),
         "latency_s_p95": percentile(lat, 0.95),
         "latency_s_p99": percentile(lat, 0.99),
         "latency_s_mean": (round(sum(lat) / len(lat), 6) if lat else None),
     }
+    if slo_p99_ms > 0:
+        p99 = summary["latency_s_p99"]
+        summary["slo"] = {
+            "p99_ms": slo_p99_ms,
+            "target_rps": target_rps,
+            "attained": bool(lat and not errors
+                             and p99 is not None
+                             and p99 * 1000.0 <= slo_p99_ms),
+        }
+    if sampler is not None:
+        summary["fleet"] = sampler.stop()
     if serve_jsonl:
         summary["server"] = summarize_serve_jsonl(serve_jsonl, since=t_start)
     return summary
@@ -151,12 +261,22 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
 
 def print_human(s: dict) -> None:
     print(f"bench: {s['url']} x{s['concurrency']} closed-loop")
-    print(f"  {s['completed']}/{s['requests']} ok ({s['errors']} errors) "
-          f"in {s['elapsed_s']:.2f}s -> {s['throughput_rps']:.1f} req/s")
+    print(f"  {s['completed']}/{s['requests']} ok ({s['errors']} errors, "
+          f"{s['shed']} shed) in {s['elapsed_s']:.2f}s -> "
+          f"{s['throughput_rps']:.1f} req/s")
     if s["latency_s_p50"] is not None:
         print(f"  client latency: p50 {1e3 * s['latency_s_p50']:.1f}ms  "
               f"p95 {1e3 * s['latency_s_p95']:.1f}ms  "
               f"p99 {1e3 * s['latency_s_p99']:.1f}ms")
+    slo = s.get("slo")
+    if slo:
+        print(f"  SLO p99 <= {slo['p99_ms']:.0f}ms: "
+              f"{'ATTAINED' if slo['attained'] else 'MISSED'}")
+    fleet = s.get("fleet")
+    if fleet:
+        print(f"  fleet: {fleet['ready_end']}/{fleet['replicas']} ready at "
+              f"end (min {fleet['ready_min']}), "
+              f"{fleet['replica_restarts']} restarts")
     srv = s.get("server")
     if srv and srv["records"]:
         print(f"  server ({srv['records']} records): "
@@ -182,12 +302,22 @@ def main(argv=None) -> int:
     p.add_argument("--serve_jsonl", type=str, default="",
                    help="server's serve.jsonl (--metrics_dir) to fold "
                         "server-side latency/queue/occupancy into the report")
+    p.add_argument("--target_rps", type=float, default=0.0,
+                   help="pace the offered load to this rate (0 = saturate)")
+    p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                   help="add an SLO verdict: attained iff client p99 is "
+                        "within this and errors == 0")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="expected fleet size: sample the router's /metrics "
+                        "during the run and report rotation + restarts")
     p.add_argument("--json", action="store_true",
                    help="emit the summary as one JSON object (CI mode)")
     args = p.parse_args(argv)
 
     summary = run_bench(args.url, args.concurrency, args.requests,
-                        args.image_size, args.timeout, args.serve_jsonl)
+                        args.image_size, args.timeout, args.serve_jsonl,
+                        target_rps=args.target_rps,
+                        slo_p99_ms=args.slo_p99_ms, replicas=args.replicas)
     if args.json:
         print(json.dumps(summary, sort_keys=True))
     else:

@@ -165,6 +165,13 @@ class Config:
     max_batch_wait_ms: float = 5.0      # dynamic batcher flush deadline: a queued request waits at
     #   most this long for the bucket to fill (vitax/serve/batcher.py)
     serve_topk: int = 5                 # classes returned per /predict response
+    serve_queue_max: int = 1024         # dynamic batcher queue bound: submit() on a full queue raises
+    #   QueueFull, which the single-engine server answers 503 (reason
+    #   "queue_full") and the fleet router maps to an admission shed (429)
+    #   — the backpressure floor under overload. 0 = unbounded (pre-PR-8)
+    serve_request_timeout_s: float = 60.0  # ceiling a /predict handler waits on its batch future before
+    #   answering 503: batcher deadline + one engine batch + generous slack
+    #   (was the hardcoded REQUEST_TIMEOUT_S); surfaced in /metrics
 
     @property
     def resolved_param_gather_dtype(self) -> str:
@@ -348,6 +355,16 @@ class Config:
             f"--serve_topk must be >= 1, got {self.serve_topk}; values above "
             f"num_classes are clamped by the engine at load time "
             f"(vitax/serve/engine.py)")
+        assert self.serve_queue_max >= 0, (
+            f"--serve_queue_max must be >= 0 (0 = unbounded), got "
+            f"{self.serve_queue_max}: the batcher's pending deque is the "
+            f"only queue in the serve path and a negative bound is "
+            f"meaningless")
+        assert self.serve_request_timeout_s > 0, (
+            f"--serve_request_timeout_s must be > 0, got "
+            f"{self.serve_request_timeout_s}: a /predict handler that waits "
+            f"zero seconds on its batch future would answer 503 before the "
+            f"batcher could possibly flush")
         assert self.resolved_param_gather_dtype in ("bfloat16", "float32"), (
             f"unknown param_gather_dtype {self.param_gather_dtype!r}")
         assert self.grad_reduce_dtype in ("bfloat16", "float32"), (
@@ -524,6 +541,16 @@ def build_parser() -> argparse.ArgumentParser:
                             "bucket to fill before the batch is flushed")
     serve.add_argument("--serve_topk", type=int, default=5,
                        help="classes returned per /predict response")
+    serve.add_argument("--serve_queue_max", type=int, default=1024,
+                       help="dynamic batcher queue bound: a submit against "
+                            "a full queue raises QueueFull, answered 503 "
+                            "(reason queue_full) by the single-engine "
+                            "server and shed as 429 by the fleet router "
+                            "(0 = unbounded)")
+    serve.add_argument("--serve_request_timeout_s", type=float, default=60.0,
+                       help="seconds a /predict handler waits on its batch "
+                            "future before answering 503 (> 0; surfaced in "
+                            "/metrics)")
     return parser
 
 

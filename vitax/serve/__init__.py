@@ -6,10 +6,12 @@ eval-mode forward -> dynamic micro-batcher -> HTTP front end.
 
 See vitax/serve/engine.py (bucketed AOT forward), batcher.py (dynamic
 micro-batching), server.py (HTTP + telemetry), and the README "Serving"
-section.
+section. The horizontal tier — N replicas behind a least-loaded router
+with admission control — lives in vitax/serve/fleet/ (python -m
+vitax.serve.fleet --replicas N ...).
 """
 
-from vitax.serve.batcher import BatchResult, DynamicBatcher  # noqa: F401
+from vitax.serve.batcher import BatchResult, DynamicBatcher, QueueFull  # noqa: F401
 from vitax.serve.engine import (  # noqa: F401
     InferenceEngine,
     bucket_sizes,
@@ -18,6 +20,7 @@ from vitax.serve.engine import (  # noqa: F401
 from vitax.serve.server import (  # noqa: F401
     REQUIRED_SERVE_KEYS,
     ServeMetrics,
+    drain,
     serve_forever,
     start_server,
     stop_server,

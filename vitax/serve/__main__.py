@@ -36,7 +36,10 @@ def main(argv=None) -> int:
     else:
         engine = InferenceEngine.from_checkpoint(
             cfg, cfg.ckpt_dir, None if ns.epoch < 0 else ns.epoch)
-    engine.warmup()
+    # serve_forever binds first, THEN warms: /healthz answers (live,
+    # ready: false) while the AOT buckets compile, so a fleet router can
+    # watch the replica warm without routing to it; SIGTERM drains cleanly
+    # (in-flight answered, batcher flushed) and we exit 0
     serve_forever(cfg, engine)
     return 0
 

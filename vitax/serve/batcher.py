@@ -23,6 +23,17 @@ from typing import Callable, Optional
 import numpy as np
 
 
+class QueueFull(RuntimeError):
+    """submit() against a batcher whose pending queue is at queue_max.
+
+    The typed overload signal of the serve path: the single-engine HTTP
+    server maps it to 503 (reason "queue_full"), the fleet router
+    (vitax/serve/fleet/router.py) maps a replica's queue-full 503 to an
+    admission shed (429 + Retry-After). Before the bound existed the deque
+    grew without limit under overload and every queued request eventually
+    timed out — now the queue depth is bounded by --serve_queue_max."""
+
+
 class BatchResult:
     """Per-request slice of a flushed batch, plus the batch's accounting
     (queue wait, engine latency, occupancy) for telemetry."""
@@ -52,11 +63,14 @@ class DynamicBatcher:
     def __init__(self, predict_fn: Callable, max_batch: int,
                  max_wait_ms: float,
                  bucket_of: Optional[Callable[[int], int]] = None,
-                 on_batch: Optional[Callable[[dict], None]] = None):
+                 on_batch: Optional[Callable[[dict], None]] = None,
+                 queue_max: int = 0):
         assert max_batch >= 1
+        assert queue_max >= 0, queue_max
         self.predict_fn = predict_fn
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
+        self.queue_max = queue_max        # 0 = unbounded (pre-bound behavior)
         self.bucket_of = bucket_of or (lambda n: n)
         self.on_batch = on_batch          # telemetry hook, called per flush
         self.batches_flushed = 0
@@ -68,11 +82,19 @@ class DynamicBatcher:
         self._worker.start()
 
     def submit(self, image: np.ndarray) -> Future:
-        """Enqueue one (H, W, 3) image; resolves to a BatchResult."""
+        """Enqueue one (H, W, 3) image; resolves to a BatchResult.
+
+        Raises QueueFull when `queue_max` requests are already pending —
+        overload is answered at admission time, not by letting the deque
+        grow until every queued request times out."""
         fut: Future = Future()
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            if self.queue_max and len(self._pending) >= self.queue_max:
+                raise QueueFull(
+                    f"{len(self._pending)} requests already pending "
+                    f"(--serve_queue_max {self.queue_max})")
             self._pending.append((image, fut, time.time()))
             self._cond.notify()
         return fut

@@ -91,6 +91,11 @@ class InferenceEngine:
         self.topk = min(cfg.serve_topk, cfg.num_classes)
         self.buckets = bucket_sizes(cfg.serve_max_batch)
         self.compile_count = 0          # warmup compiles; pinned by tests
+        # readiness vs liveness: the HTTP server is LIVE as soon as it binds
+        # (healthz answers), but READY only once every AOT bucket is compiled
+        # and exercised — a fleet router must not dispatch to a warming
+        # replica (vitax/serve/fleet/replica.py keys off healthz "ready")
+        self.ready = False
         self._compiled: Dict[int, jax.stages.Compiled] = {}
         self._batch_shardings: Dict[int, NamedSharding] = {}
         # batch-carrying device count: buckets divisible by it shard the
@@ -189,6 +194,7 @@ class InferenceEngine:
             idx, probs = self._run(b, zeros)
             jax.block_until_ready((idx, probs))
             timings[b] = time.time() - t0
+        self.ready = True
         master_print("serve: warmup compiled buckets "
                      + ", ".join(f"{b}:{t:.2f}s" for b, t in timings.items()))
         return timings

@@ -1,0 +1,37 @@
+"""vitax.serve.fleet — replica fleet, least-loaded router, admission control.
+
+The horizontal tier over vitax.serve (ROADMAP north star: planet-scale
+serving): N single-engine replicas behind one front door.
+
+    python -m vitax.serve.fleet --replicas 2 --ckpt_dir /ckpts \\
+        --embed_dim 5120 ... --serve_port 8000 --slo_p99_ms 500
+
+Three layers, bottom up:
+- replica.py   — ReplicaManager: spawn/adopt replicas, health-driven
+                 rotation (eject on failure or ready: false, re-admit
+                 after re-warm), restart-with-backoff via the
+                 vitax.supervise seams;
+- router.py    — Router + stdlib HTTP front door: least-loaded dispatch,
+                 one retry on a different replica, fleet-wide /metrics;
+- admission.py — AdmissionController: predicted-wait 429 shedding with
+                 Retry-After against the --slo_p99_ms deadline.
+
+Clients see the single-engine contract unchanged; tests/test_fleet.py
+pins the rotation, retry, and overload behaviors.
+"""
+
+from vitax.serve.fleet.admission import AdmissionController  # noqa: F401
+from vitax.serve.fleet.replica import (  # noqa: F401
+    DEAD,
+    EJECTED,
+    READY,
+    STARTING,
+    Replica,
+    ReplicaManager,
+)
+from vitax.serve.fleet.router import (  # noqa: F401
+    Router,
+    RouterMetrics,
+    start_router,
+    stop_router,
+)

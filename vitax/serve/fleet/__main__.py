@@ -1,0 +1,147 @@
+"""CLI entry: python -m vitax.serve.fleet — N replicas behind one router.
+
+Shares the single-replica CLI surface (every python -m vitax.serve flag
+works here and is forwarded to the replicas) plus the fleet flags:
+
+    python -m vitax.serve.fleet --replicas 2 --ckpt_dir /ckpts \\
+        --embed_dim 5120 ... --serve_port 8000 --slo_p99_ms 500
+
+The router binds --serve_port; replica i binds --base_port + i (default
+base_port = serve_port + 1). When --metrics_dir is set the router writes
+<metrics_dir>/serve.jsonl (admission sheds, replica lifecycle) and each
+replica writes its own under <metrics_dir>/replica_<i>/. SIGTERM/SIGINT
+shut down the router, then SIGTERM-drain every replica (in-flight
+answered, exit 0).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import List, Sequence
+
+from vitax.config import Config, build_parser, config_fields_from_namespace
+
+# fleet/source flags that must NOT be forwarded to replica processes
+# (value-taking form: both "--flag v" and "--flag=v" are stripped)
+_FLEET_ONLY_FLAGS = (
+    "--replicas", "--base_port", "--slo_p99_ms", "--health_interval_s",
+    "--fail_threshold", "--replica_max_restarts",
+    # replica-specific overrides the fleet re-issues per replica:
+    "--serve_port", "--metrics_dir",
+)
+
+
+def strip_flags(argv: Sequence[str], flags: Sequence[str]) -> List[str]:
+    """Drop value-taking flags (and their values) from an argv copy, in
+    both "--flag value" and "--flag=value" spellings."""
+    out: List[str] = []
+    skip = False
+    for arg in argv:
+        if skip:
+            skip = False
+            continue
+        name = arg.split("=", 1)[0]
+        if name in flags:
+            skip = "=" not in arg
+            continue
+        out.append(arg)
+    return out
+
+
+def replica_argv(argv: Sequence[str], port: int,
+                 metrics_dir: str = "") -> List[str]:
+    """The subprocess command for one replica: the fleet CLI minus the
+    fleet-only flags, re-targeted at this replica's port/metrics dir."""
+    child = [sys.executable, "-m", "vitax.serve"]
+    child += strip_flags(argv, _FLEET_ONLY_FLAGS)
+    child += ["--serve_port", str(port)]
+    if metrics_dir:
+        child += ["--metrics_dir", metrics_dir]
+    return child
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = build_parser()
+    src = parser.add_argument_group("vitax serve source")
+    src.add_argument("--npz", type=str, default="",
+                     help="consolidated .npz export to serve (overrides "
+                          "--ckpt_dir/--epoch)")
+    src.add_argument("--epoch", type=int, default=-1,
+                     help="epoch checkpoint to serve (-1 = latest under "
+                          "--ckpt_dir)")
+    fleet = parser.add_argument_group("vitax serve fleet")
+    fleet.add_argument("--replicas", type=int, default=2,
+                       help="engine replicas to spawn behind the router")
+    fleet.add_argument("--base_port", type=int, default=0,
+                       help="replica i binds base_port + i "
+                            "(0 = serve_port + 1)")
+    fleet.add_argument("--slo_p99_ms", type=float, default=0.0,
+                       help="p99 deadline for admission control: arrivals "
+                            "whose predicted queue wait exceeds it are shed "
+                            "with 429 + Retry-After (0 = shedding off)")
+    fleet.add_argument("--health_interval_s", type=float, default=0.5,
+                       help="seconds between replica /healthz sweeps")
+    fleet.add_argument("--fail_threshold", type=int, default=2,
+                       help="consecutive failed health polls before a READY "
+                            "replica is ejected from rotation")
+    fleet.add_argument("--replica_max_restarts", type=int, default=10,
+                       help="restarts-with-backoff per replica before the "
+                            "fleet gives up on it")
+    ns = parser.parse_args(argv)
+    cfg = Config(**config_fields_from_namespace(ns)).validate()
+    assert ns.replicas >= 1, f"--replicas must be >= 1, got {ns.replicas}"
+    base_port = ns.base_port or cfg.serve_port + 1
+
+    from vitax.serve.server import build_serve_recorder
+    from vitax.serve.fleet.admission import AdmissionController
+    from vitax.serve.fleet.replica import ReplicaManager
+    from vitax.serve.fleet.router import Router, start_router, stop_router
+
+    recorder = build_serve_recorder(cfg)
+    manager = ReplicaManager(
+        recorder=recorder, health_interval_s=ns.health_interval_s,
+        fail_threshold=ns.fail_threshold,
+        max_restarts=ns.replica_max_restarts)
+    import os
+    for i in range(ns.replicas):
+        port = base_port + i
+        metrics_dir = (os.path.join(cfg.metrics_dir, f"replica_{i}")
+                       if cfg.metrics_dir else "")
+        manager.manage(replica_argv(argv, port, metrics_dir),
+                       f"http://127.0.0.1:{port}", name=f"replica_{i}")
+    manager.start()
+
+    admission = AdmissionController(ns.slo_p99_ms, recorder=recorder)
+    router = Router(manager, admission=admission, recorder=recorder,
+                    request_timeout_s=cfg.serve_request_timeout_s)
+    httpd = start_router(router, cfg.serve_port)
+    print(f"fleet: router on :{httpd.server_address[1]}, {ns.replicas} "
+          f"replicas on :{base_port}..:{base_port + ns.replicas - 1} "
+          f"(slo_p99_ms {ns.slo_p99_ms or 'off'})", flush=True)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — handler signature
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded use)
+    while not stop.wait(timeout=0.5):
+        pass
+    print("fleet: shutting down (router first, then replica drains)",
+          flush=True)
+    stop_router(httpd)
+    manager.stop()  # SIGTERM-drains each replica: in-flight answered
+    if recorder is not None:
+        recorder.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Admission control: shed load the fleet cannot answer inside its deadline.
+
+Orca-style (OSDI '22) continuous admission, adapted to the request level:
+instead of queueing every request and letting the slow ones blow the tail,
+the router predicts each arrival's queue delay —
+
+    predicted_wait = in_flight_depth * EWMA(service time) / ready_replicas
+
+— and when that prediction exceeds the configured p99 deadline
+(--slo_p99_ms), answers **429 Too Many Requests** with a `Retry-After`
+header sized from the prediction overshoot. Clients that honor Retry-After
+form a closed loop: offered load converges to what the fleet can serve
+inside the SLO, and nobody waits in a queue for an answer that would
+arrive too late to matter.
+
+Sheds are contract behavior, not errors: tools/serve_bench.py counts them
+separately from failures, and every shed emits a `kind:"admission"`
+telemetry event so tools/metrics_report.py can report the shed count.
+
+Deadline <= 0 disables shedding (every request admitted) — the fleet then
+degrades to pure least-loaded routing with queue-full backpressure.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+
+class AdmissionController:
+    """Predictive bounded-queue admission for one router. Thread-safe:
+    `observe` and `check` are called from concurrent handler threads."""
+
+    def __init__(self, deadline_ms: float, recorder=None,
+                 ewma_alpha: float = 0.2):
+        self.deadline_s = deadline_ms / 1000.0
+        self.recorder = recorder
+        self.ewma_alpha = ewma_alpha
+        self.ewma_service_s: Optional[float] = None
+        self.admitted_total = 0
+        self.shed_total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, service_s: float) -> None:
+        """Fold one successful dispatch's end-to-end service time into the
+        EWMA the wait prediction is built on."""
+        with self._lock:
+            prev = self.ewma_service_s
+            self.ewma_service_s = (
+                service_s if prev is None else
+                self.ewma_alpha * service_s + (1.0 - self.ewma_alpha) * prev)
+
+    def check(self, depth: int, ready_replicas: int) -> Optional[int]:
+        """Admit (None) or shed (int seconds for Retry-After).
+
+        Admits unconditionally while shedding is off (deadline <= 0), before
+        the first observation (no basis for a prediction), or with no ready
+        replicas (the router's 503 path owns that case)."""
+        with self._lock:
+            ewma = self.ewma_service_s
+            if self.deadline_s <= 0 or ewma is None or ready_replicas <= 0:
+                self.admitted_total += 1
+                return None
+            predicted = depth * ewma / max(ready_replicas, 1)
+            if predicted <= self.deadline_s:
+                self.admitted_total += 1
+                return None
+            self.shed_total += 1
+            retry_after = max(int(math.ceil(predicted - self.deadline_s)), 1)
+        self._event(decision="shed", depth=depth,
+                    predicted_wait_s=round(predicted, 6),
+                    deadline_ms=self.deadline_s * 1000.0,
+                    retry_after_s=retry_after)
+        return retry_after
+
+    def record_shed(self, **payload) -> None:
+        """Count a shed decided elsewhere (a replica answered queue_full and
+        the router mapped it to 429) so fleet shed accounting is complete."""
+        with self._lock:
+            self.shed_total += 1
+        self._event(decision="shed", **payload)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "deadline_ms": self.deadline_s * 1000.0,
+                "ewma_service_s": (round(self.ewma_service_s, 6)
+                                   if self.ewma_service_s is not None
+                                   else None),
+                "admitted_total": self.admitted_total,
+                "shed_total": self.shed_total,
+            }
+
+    def _event(self, **payload) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.event("admission", **payload)
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] telemetry must not kill admission
+                pass

@@ -1,0 +1,299 @@
+"""Least-loaded HTTP router: one front door over N serve replicas.
+
+Speaks the exact single-engine contract (POST /predict, GET /healthz,
+GET /metrics — clients cannot tell a fleet from one replica) and adds the
+fleet behaviors on top:
+
+- **least-loaded dispatch**: each /predict goes to the READY replica with
+  the fewest in-flight requests (ties broken by EWMA latency), via
+  ReplicaManager.acquire()/release();
+- **one retry**: a dispatch failure (connection refused, replica 5xx,
+  socket timeout) is retried once on a DIFFERENT replica — /predict is
+  idempotent, so the retry is safe and hides single-replica deaths from
+  clients;
+- **admission control**: before dispatch, the AdmissionController predicts
+  this request's queue delay; over-deadline arrivals get 429 +
+  Retry-After (see admission.py). A replica's own queue-full 503 is
+  mapped to the same 429 shed — backpressure composes up the stack;
+- **fleet metrics**: GET /metrics aggregates router-side p50/p95/p99 and
+  per-replica rotation/load state, folding in each ready replica's own
+  /metrics, so one scrape shows the whole fleet.
+
+Stdlib-only and jax-free: the router runs on a box with no accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from vitax.serve.fleet.admission import AdmissionController
+from vitax.serve.fleet.replica import ReplicaManager
+
+DISPATCH_ATTEMPTS = 2  # first pick + one retry on a different replica
+
+
+def _percentile(sorted_vals, q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    pos = (len(sorted_vals) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return float(sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac)
+
+
+class RouterMetrics:
+    """Thread-safe router-side counters behind the fleet GET /metrics."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self.started = time.time()
+        self.requests_total = 0
+        self.errors_total = 0
+        self.shed_total = 0
+        self.retries_total = 0
+        self._latency = deque(maxlen=window)
+        self._times = deque(maxlen=window)
+
+    def observe(self, latency_s: float) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self._latency.append(latency_s)
+            self._times.append(time.time())
+
+    def error(self) -> None:
+        with self._lock:
+            self.errors_total += 1
+
+    def shed(self) -> None:
+        with self._lock:
+            self.shed_total += 1
+
+    def retry(self) -> None:
+        with self._lock:
+            self.retries_total += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._latency)
+            times = list(self._times)
+            total, errors = self.requests_total, self.errors_total
+            shed, retries = self.shed_total, self.retries_total
+        now = time.time()
+        recent = [t for t in times if now - t <= 60.0]
+        return {
+            "requests_total": total,
+            "errors_total": errors,
+            "shed_total": shed,
+            "retries_total": retries,
+            "uptime_s": round(now - self.started, 3),
+            "requests_per_sec": round(total / max(now - self.started, 1e-9), 3),
+            "requests_per_sec_60s": round(len(recent) / 60.0, 3),
+            "latency_s_p50": _percentile(lat, 0.50),
+            "latency_s_p95": _percentile(lat, 0.95),
+            "latency_s_p99": _percentile(lat, 0.99),
+        }
+
+
+class Router:
+    """Dispatch policy + fleet observability; the HTTP shell is
+    start_router(). Separated so tests drive dispatch() directly."""
+
+    def __init__(self, manager: ReplicaManager,
+                 admission: Optional[AdmissionController] = None,
+                 recorder=None, request_timeout_s: float = 60.0):
+        self.manager = manager
+        self.admission = admission
+        self.recorder = recorder
+        self.request_timeout_s = request_timeout_s
+        self.metrics = RouterMetrics()
+
+    # -- dispatch --------------------------------------------------------------
+
+    def dispatch(self, body: bytes,
+                 content_type: str) -> Tuple[int, dict, object]:
+        """Route one /predict. Returns (status, extra headers, payload):
+        payload is raw bytes on 200 (the replica's JSON passed through
+        verbatim) and a dict (to be JSON-encoded) otherwise."""
+        ready = self.manager.ready_count()
+        if ready == 0:
+            self.metrics.error()
+            return 503, {"Retry-After": "1"}, {
+                "error": "no ready replicas", "reason": "no_ready_replicas"}
+        if self.admission is not None:
+            retry_after = self.admission.check(
+                self.manager.total_in_flight(), ready)
+            if retry_after is not None:
+                self.metrics.shed()
+                return 429, {"Retry-After": str(retry_after)}, {
+                    "error": "shed: predicted wait exceeds the p99 deadline",
+                    "reason": "admission"}
+        exclude = set()
+        for attempt in range(DISPATCH_ATTEMPTS):
+            replica = self.manager.acquire(exclude=exclude)
+            if replica is None:
+                break
+            t0 = time.monotonic()
+            try:
+                req = urllib.request.Request(
+                    replica.url + "/predict", data=body,
+                    headers={"Content-Type": content_type or
+                             "application/octet-stream"})
+                with urllib.request.urlopen(
+                        req, timeout=self.request_timeout_s) as resp:
+                    out = resp.read()
+                latency = time.monotonic() - t0
+                self.manager.release(replica, latency_s=latency, ok=True)
+                if self.admission is not None:
+                    self.admission.observe(latency)
+                self.metrics.observe(latency)
+                return 200, {}, out
+            except urllib.error.HTTPError as e:
+                payload = self._json_body(e)
+                if e.code == 503 and payload.get("reason") == "queue_full":
+                    # replica backpressure -> fleet admission shed: clients
+                    # see one uniform overload signal (429 + Retry-After)
+                    self.manager.release(replica, ok=False)
+                    self.metrics.shed()
+                    if self.admission is not None:
+                        self.admission.record_shed(
+                            reason="replica_queue_full", replica=replica.name)
+                    retry_hdr = e.headers.get("Retry-After", "1") \
+                        if e.headers else "1"
+                    return 429, {"Retry-After": retry_hdr}, {
+                        "error": "shed: replica queue full",
+                        "reason": "replica_queue_full"}
+                if 400 <= e.code < 500:
+                    # the client's fault (bad image, bad topk): pass the
+                    # replica's verdict through verbatim, never retry
+                    self.manager.release(replica, ok=False)
+                    self.metrics.error()
+                    return e.code, {}, payload or {
+                        "error": f"replica answered {e.code}"}
+                self._dispatch_failed(replica, exclude, attempt,
+                                      f"HTTP {e.code}")
+            except Exception as e:  # noqa: BLE001 — refused/timeout/reset
+                self._dispatch_failed(replica, exclude, attempt,
+                                      f"{type(e).__name__}: {e}")
+        self.metrics.error()
+        return 503, {"Retry-After": "1"}, {
+            "error": "dispatch failed on all replicas",
+            "reason": "dispatch_failed"}
+
+    def _dispatch_failed(self, replica, exclude: set, attempt: int,
+                         detail: str) -> None:
+        self.manager.release(replica, ok=False)
+        exclude.add(replica.name)
+        if attempt + 1 < DISPATCH_ATTEMPTS:
+            self.metrics.retry()
+        self._event("dispatch_retry", replica=replica.name, attempt=attempt,
+                    detail=detail)
+
+    @staticmethod
+    def _json_body(e: urllib.error.HTTPError) -> dict:
+        try:
+            payload = json.loads(e.read().decode("utf-8"))
+            return payload if isinstance(payload, dict) else {}
+        except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] non-JSON error body is expected from dead proxies
+            return {}
+
+    # -- observability -----------------------------------------------------------
+
+    def healthz(self) -> dict:
+        replicas = self.manager.snapshot()
+        return {
+            "status": "ok",
+            "ready": self.manager.ready_count() > 0,
+            "replicas": {name: snap["state"]
+                         for name, snap in replicas.items()},
+        }
+
+    def fleet_metrics(self) -> dict:
+        replicas = self.manager.snapshot()
+        # fold each ready replica's own /metrics in (fail-soft: a replica
+        # dying mid-scrape must not fail the fleet scrape)
+        for r in self.manager.ready_replicas():
+            try:
+                replicas[r.name]["server"] = self.manager._http_get(
+                    r.url + "/metrics", self.manager.health_timeout_s)
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] scrape is best-effort by contract
+                pass
+        snap = self.metrics.snapshot()
+        snap["request_timeout_s"] = self.request_timeout_s
+        snap["fleet"] = {
+            "size": len(replicas),
+            "ready": self.manager.ready_count(),
+            "in_flight": self.manager.total_in_flight(),
+            "replica_restarts": self.manager.restart_total,
+        }
+        snap["replicas"] = replicas
+        if self.admission is not None:
+            snap["admission"] = self.admission.snapshot()
+        return snap
+
+    def _event(self, kind: str, **payload) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.event(kind, **payload)
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] telemetry must not kill dispatch
+                pass
+
+
+def _make_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: A003
+            pass
+
+        def _reply(self, code: int, payload, headers=None) -> None:
+            body = (payload if isinstance(payload, bytes)
+                    else json.dumps(payload).encode("utf-8"))
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path == "/healthz":
+                self._reply(200, router.healthz())
+            elif self.path == "/metrics":
+                self._reply(200, router.fleet_metrics())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            code, headers, payload = router.dispatch(
+                body, self.headers.get("Content-Type", ""))
+            self._reply(code, payload, headers=headers)
+
+    return Handler
+
+
+def start_router(router: Router, port: int):
+    """Bind the fleet front door (background thread). Returns the httpd;
+    httpd.server_address[1] is the bound port (0 = ephemeral, tests)."""
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), _make_handler(router))
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="vitax-fleet-router")
+    thread.start()
+    return httpd
+
+
+def stop_router(httpd) -> None:
+    httpd.shutdown()
+    httpd.server_close()

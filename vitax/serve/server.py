@@ -8,9 +8,22 @@ Endpoints:
                    (vitax/data/transforms.py ValTransform), then the
                    dynamic batcher; response is
                    {"classes": [...], "probs": [...], "latency_ms": ...}.
-- GET /healthz   — liveness + the engine's compiled bucket set.
+- GET /healthz   — liveness + readiness: the server is LIVE once it binds
+                   (status "ok") but READY only after AOT bucket warmup
+                   completes and while not draining — a fleet router
+                   (vitax/serve/fleet/) keys rotation off "ready".
 - GET /metrics   — aggregate counters: requests/s, latency p50/p95/p99,
-                   queue wait, batch occupancy, queue depth.
+                   queue wait, batch occupancy, queue depth, the configured
+                   request timeout, readiness/drain state.
+
+Overload and shutdown semantics:
+- a full batcher queue (--serve_queue_max) answers 503 with JSON reason
+  "queue_full" and Retry-After — the fleet router maps that to an
+  admission shed (429);
+- SIGTERM drains gracefully (python -m vitax.serve): stop accepting new
+  work (ready: false, new /predict -> 503), answer every in-flight
+  request, flush the batcher, exit 0 — so a ReplicaManager restart never
+  drops an accepted request.
 
 Observability rides the existing vitax.telemetry Recorder/sinks: one
 schema-versioned JSONL record per request (kind "serve_request") plus
@@ -23,6 +36,7 @@ from __future__ import annotations
 import base64
 import io
 import json
+import signal
 import sys
 import threading
 import time
@@ -32,7 +46,7 @@ from typing import Optional
 
 from vitax.config import Config
 from vitax.serve.engine import InferenceEngine
-from vitax.serve.batcher import DynamicBatcher
+from vitax.serve.batcher import DynamicBatcher, QueueFull
 from vitax.platform import device_kind
 from vitax.utils.logging import master_print
 
@@ -45,7 +59,8 @@ REQUIRED_SERVE_KEYS = (
 
 # a request outlives at most: its batcher deadline + one engine batch +
 # generous slack — beyond that the handler answers 503 instead of hanging
-# the client forever
+# the client forever. Default for --serve_request_timeout_s (and the
+# fallback when a Config predates the flag).
 REQUEST_TIMEOUT_S = 60.0
 
 
@@ -142,6 +157,14 @@ class ServeContext:
         self.engine = engine
         self.recorder = recorder
         self.metrics = ServeMetrics()
+        self.request_timeout_s = float(
+            getattr(cfg, "serve_request_timeout_s", REQUEST_TIMEOUT_S))
+        # drain/readiness state: handlers enter through enter_request() so a
+        # drain can wait for the in-flight count to reach zero before the
+        # batcher is flushed and the process exits
+        self.draining = False
+        self._inflight = 0
+        self._flight_cond = threading.Condition()
         # normalize=False: the eval stack emits uint8 HWC and the engine's
         # compiled program normalizes on device (vitax/train/step.py
         # prepare_images) — the same split training uses
@@ -151,7 +174,44 @@ class ServeContext:
             engine.predict, max_batch=cfg.serve_max_batch,
             max_wait_ms=cfg.max_batch_wait_ms,
             bucket_of=lambda n: next_bucket(n, engine.buckets),
-            on_batch=self._record_batch)
+            on_batch=self._record_batch,
+            queue_max=getattr(cfg, "serve_queue_max", 0))
+
+    def is_ready(self) -> bool:
+        """READY = warmed up and not draining. Distinct from liveness: a
+        warming or draining server still answers /healthz (live) but must
+        not receive routed traffic."""
+        return not self.draining and getattr(self.engine, "ready", True)
+
+    def enter_request(self) -> bool:
+        """Admit one /predict into the in-flight set; False when the server
+        is warming or draining (the handler answers 503)."""
+        with self._flight_cond:
+            if not self.is_ready():
+                return False
+            self._inflight += 1
+            return True
+
+    def exit_request(self) -> None:
+        with self._flight_cond:
+            self._inflight -= 1
+            self._flight_cond.notify_all()
+
+    def inflight(self) -> int:
+        with self._flight_cond:
+            return self._inflight
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until every in-flight request is answered (drain step 2);
+        False if `timeout_s` elapsed with requests still in flight."""
+        deadline = time.monotonic() + timeout_s
+        with self._flight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._flight_cond.wait(timeout=remaining)
+            return True
 
     def _record_batch(self, stats: dict) -> None:
         if self.recorder is not None:
@@ -188,18 +248,23 @@ def _make_handler(ctx: ServeContext):
         def log_message(self, fmt, *args):  # noqa: A003
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
             if self.path == "/healthz":
                 self._reply(200, {
-                    "status": "ok",
+                    "status": "ok",                 # liveness: we answered
+                    "ready": ctx.is_ready(),        # routable: warmed + not draining
+                    "draining": ctx.draining,
                     "buckets": list(ctx.engine.buckets),
                     "topk": ctx.engine.topk,
                     "compile_count": ctx.engine.compile_count,
@@ -207,8 +272,12 @@ def _make_handler(ctx: ServeContext):
             elif self.path == "/metrics":
                 snap = ctx.metrics.snapshot()
                 snap["queue_depth"] = ctx.batcher.queue_depth()
+                snap["queue_max"] = ctx.batcher.queue_max
                 snap["batches_flushed"] = ctx.batcher.batches_flushed
                 snap["compile_count"] = ctx.engine.compile_count
+                snap["request_timeout_s"] = ctx.request_timeout_s
+                snap["ready"] = ctx.is_ready()
+                snap["draining"] = ctx.draining
                 self._reply(200, snap)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
@@ -217,6 +286,19 @@ def _make_handler(ctx: ServeContext):
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
+            if not ctx.enter_request():
+                reason = "draining" if ctx.draining else "warming_up"
+                ctx.metrics.error()
+                self._reply(503, {"error": f"not ready: {reason}",
+                                  "reason": reason},
+                            headers={"Retry-After": "1"})
+                return
+            try:
+                self._predict()
+            finally:
+                ctx.exit_request()
+
+        def _predict(self) -> None:
             t0 = time.time()
             try:
                 length = int(self.headers.get("Content-Length", 0))
@@ -228,8 +310,17 @@ def _make_handler(ctx: ServeContext):
                 self._reply(400, {"error": f"bad request: {e}"})
                 return
             try:
-                result = ctx.batcher.submit(image).result(
-                    timeout=REQUEST_TIMEOUT_S)
+                fut = ctx.batcher.submit(image)
+            except QueueFull as e:
+                # typed overload: the fleet router maps this reason to an
+                # admission shed (429); a bare client just backs off
+                ctx.metrics.error()
+                self._reply(503, {"error": f"overloaded: {e}",
+                                  "reason": "queue_full"},
+                            headers={"Retry-After": "1"})
+                return
+            try:
+                result = fut.result(timeout=ctx.request_timeout_s)
             except Exception as e:  # noqa: BLE001
                 ctx.metrics.error()
                 self._reply(503, {"error": f"inference failed: {e}"})
@@ -285,13 +376,53 @@ def stop_server(httpd, ctx: ServeContext) -> None:
     ctx.close()
 
 
+def drain(httpd, ctx: ServeContext, timeout_s: float = 30.0) -> bool:
+    """Graceful shutdown: stop accepting, answer in-flight, flush, close.
+
+    The SIGTERM contract a ReplicaManager restart relies on — an accepted
+    request is never dropped:
+      1. mark draining (healthz reports ready: false; new /predict -> 503)
+         and stop the accept loop;
+      2. wait for every in-flight request to be answered (their batch
+         futures resolve through the still-running batcher worker);
+      3. close the batcher (flushes anything still queued) and telemetry.
+    Returns True when the in-flight set drained inside `timeout_s`."""
+    with ctx._flight_cond:
+        ctx.draining = True
+    httpd.shutdown()
+    idle = ctx.wait_idle(timeout_s)
+    httpd.server_close()
+    if ctx.recorder is not None:
+        ctx.recorder.event("serve_drain", clean=idle,
+                           inflight_left=ctx.inflight())
+    ctx.close()
+    if not idle:
+        master_print(f"serve: drain timed out after {timeout_s:.0f}s with "
+                     f"{ctx.inflight()} requests in flight")
+    return idle
+
+
 def serve_forever(cfg: Config, engine: InferenceEngine) -> None:
-    """Blocking entry point (python -m vitax.serve)."""
+    """Blocking entry point (python -m vitax.serve).
+
+    Binds FIRST, then warms up: /healthz is answerable (live, ready: false)
+    while the AOT buckets compile, so a fleet router can watch a replica
+    warm without routing to it. SIGTERM/SIGINT trigger the graceful drain
+    and the function returns (the CLI exits 0)."""
     httpd, ctx = start_server(cfg, engine)
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        master_print("serve: shutting down")
-    finally:
-        stop_server(httpd, ctx)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — handler signature
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded use): Ctrl-C unavailable
+    if not getattr(engine, "ready", True):
+        engine.warmup()
+    while not stop.wait(timeout=0.5):
+        pass
+    master_print("serve: draining (SIGTERM/SIGINT)")
+    drain(httpd, ctx)

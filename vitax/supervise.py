@@ -50,6 +50,43 @@ DEFAULT_BACKOFF_MAX_S = 60.0
 DEFAULT_CRASH_LOOP_TOLERANCE = 2
 DEFAULT_TERM_GRACE_S = 30.0
 
+
+def backoff_delay(restart_count: int, backoff_s: float,
+                  backoff_max_s: float) -> float:
+    """Capped exponential backoff before restart N (1-based): backoff_s
+    doubles per restart up to backoff_max_s. The shared seam between the
+    training Supervisor below and the serve fleet's ReplicaManager
+    (vitax/serve/fleet/replica.py) — one backoff policy, tested once."""
+    return min(backoff_s * (2 ** max(restart_count - 1, 0)), backoff_max_s)
+
+
+def terminate_child(proc, grace_s: float,
+                    sleep: Callable[[float], None] = time.sleep,
+                    poll_interval_s: float = 0.1) -> Optional[int]:
+    """SIGTERM -> drain window -> SIGKILL: ask `proc` to drain cleanly (the
+    child's SIGTERM path — preempt.py for training, the serve drain for
+    replicas — saves/answers and exits 0), hard-killing after `grace_s`.
+    Returns the child's exit code (None only if it outlives the kill too,
+    which a real process cannot). Shared by the Supervisor's forwarded-drain
+    and the serve fleet's replica shutdown."""
+    try:
+        proc.send_signal(signal.SIGTERM)
+    except (OSError, ValueError):
+        pass  # already gone: poll() below returns its code
+    deadline = time.monotonic() + grace_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        sleep(poll_interval_s)
+    if proc.poll() is None:
+        try:
+            proc.kill()
+        except (OSError, ValueError):
+            pass
+        for _ in range(600):  # a killed process reaps promptly
+            if proc.poll() is not None:
+                break
+            sleep(poll_interval_s)
+    return proc.poll()
+
 SCHEMA_VERSION = 1  # matches vitax.telemetry.record.SCHEMA_VERSION (kept
 # literal here so the supervisor never imports the jax-backed telemetry
 # stack into its own lightweight process)
@@ -231,8 +268,8 @@ class Supervisor:
                 self._log(f"restart budget ({self.max_restarts}) exhausted; "
                           f"giving up with exit {EXIT_BUDGET}")
                 return EXIT_BUDGET
-            delay = min(self.backoff_s * (2 ** (self.restart_count - 1)),
-                        self.backoff_max_s)
+            delay = backoff_delay(self.restart_count, self.backoff_s,
+                                  self.backoff_max_s)
             self._event(exit_code=rc, restart=self.restart_count,
                         backoff_s=delay, progress=progressed,
                         epoch=after[0], step_in_epoch=after[1])
