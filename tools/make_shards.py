@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Pack an ImageFolder tree into `.vtxshard` streaming containers.
+
+    python tools/make_shards.py --src /data/imagenet --dst /data/imagenet-shards
+    python tools/make_shards.py --src /data/imagenet --dst ... --shard_size_mb 100
+
+Reads each split (`train/`, `val/` — whichever exist) with the SAME listing
+contract as ImageFolderDataset (sorted class subdirectories, sorted os.walk
+within; vitax/data/imagefolder.py), so record order is the dataset's index
+order and labels are the identical class indices. Payloads are the original
+file bytes, verbatim — no re-encode — which is what makes the streaming and
+ImageFolder pipelines deliver bit-identical samples (tests/test_stream.py
+pins this).
+
+Output per split: size-targeted `shard-NNNNN.vtxshard` files (default ~100
+MB), a JSON index per shard, and a `stream_meta.json` manifest
+(vitax/data/stream/format.py). Point `--data_dir` at `--dst` with
+`--data_format stream` to train from it.
+
+Accelerator-free: imports only vitax.data.stream.format (no jax at work).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python tools/make_shards.py`
+    sys.path.insert(0, _REPO)
+
+from vitax.data.stream.format import DEFAULT_SHARD_SIZE_MB, ShardWriter  # noqa: E402
+
+# the extensions ImageFolderDataset accepts (vitax/data/imagefolder.py)
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+SPLITS = ("train", "val")
+
+
+def list_imagefolder(root: str):
+    """(classes, [(path, label), ...]) with ImageFolderDataset's exact
+    listing order — record i of the shard stream is sample i of the
+    ImageFolder dataset."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise FileNotFoundError(f"no class subdirectories under {root}")
+    class_to_idx = {c: i for i, c in enumerate(classes)}
+    samples = []
+    for cls in classes:
+        cls_dir = os.path.join(root, cls)
+        for dirpath, _, filenames in sorted(os.walk(cls_dir)):
+            for fname in sorted(filenames):
+                if fname.lower().endswith(IMG_EXTENSIONS):
+                    samples.append((os.path.join(dirpath, fname),
+                                    class_to_idx[cls]))
+    if not samples:
+        raise FileNotFoundError(f"no images found under {root}")
+    return classes, samples
+
+
+def pack_split(src_split: str, dst_split: str,
+               shard_size_mb: float = DEFAULT_SHARD_SIZE_MB,
+               quiet: bool = False) -> dict:
+    """Pack one ImageFolder split directory into shards; returns the split
+    manifest (also written as stream_meta.json)."""
+    classes, samples = list_imagefolder(src_split)
+    writer = ShardWriter(dst_split, classes=classes,
+                         shard_size_mb=shard_size_mb)
+    for path, label in samples:
+        with open(path, "rb") as f:
+            writer.add(f.read(), label)
+    meta = writer.close()
+    if not quiet:
+        print(f"{dst_split}: {meta['num_records']} records, "
+              f"{len(meta['shards'])} shard(s), "
+              f"{len(meta['classes'])} classes")
+    return meta
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pack an ImageFolder tree into .vtxshard streaming "
+                    "containers")
+    ap.add_argument("--src", required=True,
+                    help="ImageFolder root (holds train/ and/or val/)")
+    ap.add_argument("--dst", required=True,
+                    help="output shard root (mirrors the split layout)")
+    ap.add_argument("--shard_size_mb", type=float,
+                    default=DEFAULT_SHARD_SIZE_MB,
+                    help="target shard size in MB (default %(default)s)")
+    ap.add_argument("--splits", nargs="*", default=None,
+                    help=f"splits to pack (default: whichever of {SPLITS} "
+                         "exist under --src)")
+    args = ap.parse_args(argv)
+
+    if args.shard_size_mb <= 0:
+        ap.error("--shard_size_mb must be positive")
+    splits = args.splits
+    if not splits:
+        splits = [s for s in SPLITS
+                  if os.path.isdir(os.path.join(args.src, s))]
+        if not splits:
+            ap.error(f"no {'/'.join(SPLITS)} splits under {args.src}")
+    for split in splits:
+        src_split = os.path.join(args.src, split)
+        if not os.path.isdir(src_split):
+            ap.error(f"split directory not found: {src_split}")
+        pack_split(src_split, os.path.join(args.dst, split),
+                   args.shard_size_mb)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

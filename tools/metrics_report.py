@@ -130,6 +130,12 @@ def summarize(path: str) -> dict:
         "data_wait_s_mean": round(sum(waits) / len(waits), 6),
         "data_wait_fraction": (round(sum(wait_fracs) / len(wait_fracs), 6)
                                if wait_fracs else None),
+        # the streaming data plane's acceptance metric (ROADMAP item 3):
+        # fraction of recorded steps that were input-bound — data wait over
+        # 10% of the step. A healthy pipeline holds this at ~0.
+        "input_bound": (round(sum(1 for w in wait_fracs if w > 0.1)
+                              / len(wait_fracs), 6)
+                        if wait_fracs else None),
         "loss_first": round(losses[0], 6),
         "loss_last": round(losses[-1], 6),
         "loss_min": round(min(losses), 6),
@@ -180,6 +186,10 @@ def print_human(summary: dict) -> None:
         print(f"  data wait: {summary['data_wait_s_mean']:.4f}s/step, "
               f"{100 * summary['data_wait_fraction']:.1f}% of step "
               f"time{starved}")
+    if summary.get("input_bound") is not None:
+        flag = " (!!)" if summary["input_bound"] > 0 else ""
+        print(f"  input-bound steps (wait > 10% of step): "
+              f"{100 * summary['input_bound']:.1f}%{flag}")
     print(f"  throughput: {summary['images_per_sec_last']:.1f} images/s, "
           f"{summary['tokens_per_sec_last']:.0f} tokens/s (last record)")
     if summary["mem_peak_bytes"]:

@@ -58,6 +58,25 @@ def _prototype(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
         ctypes.c_int]
     lib.vitax_process_batch.restype = ctypes.c_int
+    # memory-source entry points (streaming shard records, serve request
+    # bodies). A stale .so built before they existed degrades gracefully:
+    # vitax/data/native.py checks has_mem_api() and falls back to PIL.
+    if hasattr(lib, "vitax_process_mem"):
+        lib.vitax_process_mem.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
+        lib.vitax_process_mem.restype = ctypes.c_int
+        lib.vitax_jpeg_size_mem.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.vitax_jpeg_size_mem.restype = ctypes.c_int
+        lib.vitax_process_batch_mem.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.vitax_process_batch_mem.restype = ctypes.c_int
     return lib
 
 

@@ -112,6 +112,64 @@ bool read_jpeg_size(const char* path, int& w, int& h) {
   return true;
 }
 
+// Memory-source decode (jpeg_mem_src): the streaming data plane
+// (vitax/data/stream/) hands shard *records* — JPEG bytes already in host
+// memory — so the pixel path must not round-trip through the filesystem.
+// Identical decode settings to decode_jpeg_file: outputs are bitwise equal
+// for the same bytes (tests/test_stream.py pins this).
+bool decode_jpeg_mem(const uint8_t* data, size_t len, std::vector<uint8_t>& rgb,
+                     int& w, int& h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  jerr.pub.emit_message = emit_nothing;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  w = static_cast<int>(cinfo.output_width);
+  h = static_cast<int>(cinfo.output_height);
+  rgb.resize(static_cast<size_t>(w) * h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = rgb.data() + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool read_jpeg_size_mem(const uint8_t* data, size_t len, int& w, int& h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  jerr.pub.emit_message = emit_nothing;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  w = static_cast<int>(cinfo.image_width);
+  h = static_cast<int>(cinfo.image_height);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
 // ---------------------------------------------------------------------------
 // PIL-parity separable resample (bicubic, Keys a=-0.5, support 2, antialias).
 // ---------------------------------------------------------------------------
@@ -331,6 +389,30 @@ int vitax_process_file(const char* path, int mode, int left, int top, int cw,
   return 0;
 }
 
+// In-memory single record: decode + process JPEG bytes (a shard record or a
+// /predict request body) exactly like vitax_process_file does a file.
+// Returns 0 on success.
+int vitax_process_mem(const uint8_t* data, int len, int mode, int left,
+                      int top, int cw, int ch, int flip, int out_size,
+                      int resize_to, int normalize, void* out) {
+  std::vector<uint8_t> rgb;
+  int w, h;
+  if (!decode_jpeg_mem(data, static_cast<size_t>(len), rgb, w, h)) return 1;
+  std::vector<uint8_t> pixels;
+  if (!process_decoded(rgb, w, h, mode, left, top, cw, ch, out_size, resize_to,
+                       pixels))
+    return 1;
+  if (normalize)
+    normalize_out(pixels, out_size, flip, static_cast<float*>(out));
+  else
+    raw_out(pixels, out_size, flip, static_cast<uint8_t*>(out));
+  return 0;
+}
+
+int vitax_jpeg_size_mem(const uint8_t* data, int len, int* w, int* h) {
+  return read_jpeg_size_mem(data, static_cast<size_t>(len), *w, *h) ? 0 : 1;
+}
+
 // Batch: params is n x 6 int32 rows {mode, left, top, cw, ch, flip}; out is
 // (n, out_size, out_size, 3) — float32 when normalize != 0, else uint8; fail
 // is n uint8 flags (1 = this item failed and its slot is untouched — caller
@@ -351,6 +433,37 @@ int vitax_process_batch(const char** paths, int n, const int32_t* params,
           : static_cast<void*>(static_cast<uint8_t*>(out) + item * i);
       int ok = vitax_process_file(paths[i], p[0], p[1], p[2], p[3], p[4], p[5],
                                   out_size, resize_to, normalize, o);
+      fail[i] = static_cast<uint8_t>(ok != 0);
+      if (ok != 0) failures.fetch_add(1);
+    }
+  };
+  int nt = std::max(1, std::min(n_threads, n));
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; t++) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+  return failures.load();
+}
+
+// Batch over in-memory records (the streaming data plane's hot path): one
+// ctypes call decodes + transforms a whole local batch of shard records on a
+// std::thread pool — no per-record Python, no GIL, no filesystem.
+int vitax_process_batch_mem(const uint8_t** datas, const int32_t* lens, int n,
+                            const int32_t* params, int out_size, int resize_to,
+                            int normalize, void* out, uint8_t* fail,
+                            int n_threads) {
+  std::atomic<int> next(0), failures(0);
+  size_t item = static_cast<size_t>(out_size) * out_size * 3;
+  auto worker = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) return;
+      const int32_t* p = params + static_cast<size_t>(i) * 6;
+      void* o = normalize
+          ? static_cast<void*>(static_cast<float*>(out) + item * i)
+          : static_cast<void*>(static_cast<uint8_t*>(out) + item * i);
+      int ok = vitax_process_mem(datas[i], lens[i], p[0], p[1], p[2], p[3],
+                                 p[4], p[5], out_size, resize_to, normalize, o);
       fail[i] = static_cast<uint8_t>(ok != 0);
       if (ok != 0) failures.fetch_add(1);
     }

@@ -121,6 +121,25 @@ def load_resume_step(ckpt_dir: str, epoch: int) -> Optional[int]:
         return None  # unreadable sidecar degrades to epoch-granular resume
 
 
+def load_stream_cursor(ckpt_dir: str, epoch: int) -> Optional[dict]:
+    """The streaming-data-plane resume cursor `(epoch, shard_cursor,
+    record_offset, shard, ...)` recorded with a MID-epoch save of `epoch`,
+    or None (ImageFolder run, boundary save, or unreadable sidecar). The
+    resume position itself is re-derived from (seed, epoch, step) — this
+    record exists so the resumed run can DETECT a drifted shard set
+    (vitax/data/stream/sampler.py check_cursor) instead of silently feeding
+    different records."""
+    path = _resume_meta_path(ckpt_dir, epoch)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            cursor = json.load(f).get("stream_cursor")
+        return cursor if isinstance(cursor, dict) else None
+    except (json.JSONDecodeError, OSError):
+        return None  # unreadable sidecar degrades to an unverified resume
+
+
 def is_committed_checkpoint(path: str) -> bool:
     """Did this checkpoint dir finish its commit? A hard crash mid-async-
     write (or a non-atomic shared store) can leave a partial `epoch_N/`
@@ -162,7 +181,8 @@ def latest_epoch(ckpt_dir: str) -> Optional[int]:
 
 def save_state(ckpt_dir: str, epoch: int, state: PyTree,
                wait: bool = False,
-               step_in_epoch: Optional[int] = None) -> str:
+               step_in_epoch: Optional[int] = None,
+               stream_cursor: Optional[dict] = None) -> str:
     """Save the train state for `epoch`; all hosts write their shards in
     parallel (reference save_ckpt with master_only=False, utils.py:24-33).
 
@@ -174,7 +194,10 @@ def save_state(ckpt_dir: str, epoch: int, state: PyTree,
     completed steps): process 0 records it in a sidecar so resume can
     continue inside the epoch instead of skipping its remainder. An
     epoch-boundary save of the same epoch deletes any stale sidecar (the
-    stored state it described has been overwritten).
+    stored state it described has been overwritten). `stream_cursor`
+    (streaming data plane, vitax/data/stream/) rides the same sidecar —
+    the `(epoch, shard_cursor, record_offset)` record the resumed run
+    validates its derived position against (load_stream_cursor).
 
     Transient OSErrors at the write (a flaky shared filesystem, a full
     scratch volume being reaped) are retried with capped exponential
@@ -214,9 +237,12 @@ def save_state(ckpt_dir: str, epoch: int, state: PyTree,
     if jax.process_index() == 0:
         meta = _resume_meta_path(ckpt_dir, epoch)
         if step_in_epoch:
+            payload = {"step_in_epoch": int(step_in_epoch)}
+            if stream_cursor is not None:
+                payload["stream_cursor"] = stream_cursor
             tmp = meta + f".tmp{os.getpid()}"
             with open(tmp, "w") as f:
-                f.write(json.dumps({"step_in_epoch": int(step_in_epoch)}))
+                f.write(json.dumps(payload))
             os.replace(tmp, meta)  # atomic: never a half-written sidecar
         elif os.path.exists(meta):
             os.remove(meta)

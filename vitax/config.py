@@ -26,6 +26,10 @@ class Config:
     fake_data: bool = False
     num_workers: int = 4
     prefetch_batches: int = 2           # host-prefetch depth of ShardedLoader (queued decoded batches)
+    data_format: str = "imagefolder"    # imagefolder = per-file directory scan (reference parity);
+    #   stream = .vtxshard streaming containers (vitax/data/stream/ — pack
+    #   with tools/make_shards.py, point --data_dir at the shard root)
+    stream_prefetch: int = 2            # host-prefetch depth of the streaming loader (>= 1)
     ckpt_dir: str = "/tmp/vit_fsdp"
     resume_epoch: int = 0               # N = resume from epoch N; -1 = auto-resume latest checkpoint
     ckpt_epoch_interval: int = 10
@@ -207,6 +211,22 @@ class Config:
         assert self.prefetch_batches >= 1, (
             f"--prefetch_batches must be >= 1, got {self.prefetch_batches}: "
             f"the loader needs at least one queued batch to hand the consumer")
+        assert self.data_format in ("imagefolder", "stream"), (
+            f"unknown data_format {self.data_format!r} "
+            f"(expected 'imagefolder' or 'stream')")
+        assert self.stream_prefetch >= 1, (
+            f"--stream_prefetch must be >= 1, got {self.stream_prefetch}: "
+            f"the streaming loader needs at least one queued batch to hand "
+            f"the consumer")
+        if self.data_format == "stream":
+            assert not self.fake_data, (
+                "--data_format stream with --fake_data is contradictory: "
+                "fake data needs no input pipeline — generate a shard set "
+                "from an ImageFolder tree with tools/make_shards.py instead")
+            assert self.data_dir, (
+                "--data_format stream needs --data_dir pointing at a shard "
+                "root (the output of tools/make_shards.py, holding "
+                "train/stream_meta.json)")
         assert self.grad_accum_steps >= 1, (
             f"--grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
         assert self.gather_overlap in ("auto", "off", "on"), (
@@ -426,6 +446,17 @@ def build_parser() -> argparse.ArgumentParser:
     ext.add_argument("--prefetch_batches", type=int, default=2,
                      help="host-prefetch depth: decoded batches the loader "
                           "keeps queued ahead of the training loop (>= 1)")
+    ext.add_argument("--data_format", type=str, default="imagefolder",
+                     choices=["imagefolder", "stream"],
+                     help="input pipeline: imagefolder = per-file directory "
+                          "scan (reference parity); stream = .vtxshard "
+                          "streaming containers (vitax/data/stream/) — pack "
+                          "an ImageFolder tree with tools/make_shards.py "
+                          "and point --data_dir at the shard root")
+    ext.add_argument("--stream_prefetch", type=int, default=2,
+                     help="host-prefetch depth of the streaming loader: "
+                          "decoded batches kept queued ahead of the "
+                          "training loop (>= 1; --data_format stream)")
     ext.add_argument("--gather_overlap", type=str, default="auto",
                      choices=["auto", "off", "on"],
                      help="double-buffered ZeRO-3 block-param gathers: the "

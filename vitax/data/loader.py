@@ -227,6 +227,13 @@ def build_datasets(cfg: Config, mesh: Mesh):
     assert cfg.batch_size % world == 0, (
         f"batch_size {cfg.batch_size} not divisible by process count {world}")
 
+    if cfg.data_format == "stream":
+        # .vtxshard streaming containers (vitax/data/stream/): same return
+        # contract, sharded-streaming input plane (config.validate() already
+        # rejected stream+fake_data)
+        from vitax.data.stream import build_stream_datasets
+        return build_stream_datasets(cfg, mesh)
+
     if cfg.fake_data:
         train_ds = FakeImageNetDataset(cfg.image_size, TRAIN_SPLIT_LEN)
         val_ds = FakeImageNetDataset(cfg.image_size, VAL_SPLIT_LEN)

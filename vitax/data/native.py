@@ -18,14 +18,30 @@ import numpy as np
 from vitax import _native
 
 _JPEG_EXT = (".jpg", ".jpeg", ".jpe", ".jfif")
+_JPEG_MAGIC = b"\xff\xd8\xff"  # SOI marker + first segment byte
 
 
 def available() -> bool:
     return _native.available()
 
 
+def mem_available() -> bool:
+    """True when the library exposes the memory-source API (vitax_process_mem
+    et al.) — a stale .so built before the streaming data plane doesn't, and
+    callers fall back to PIL for in-memory records."""
+    lib = _native.load()
+    return lib is not None and hasattr(lib, "vitax_process_mem")
+
+
 def is_jpeg_path(path: str) -> bool:
     return path.lower().endswith(_JPEG_EXT)
+
+
+def is_jpeg_bytes(data: bytes) -> bool:
+    """Content sniff: JPEG streams start with the SOI marker. Shard records
+    and /predict bodies carry no filename, so the extension check above
+    doesn't apply."""
+    return data[:3] == _JPEG_MAGIC
 
 
 def jpeg_size(path: str) -> Optional[Tuple[int, int]]:
@@ -79,6 +95,70 @@ def process_batch(paths: Sequence[str], params: Sequence[Sequence[int]],
     c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
     lib.vitax_process_batch(
         c_paths, n, params_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_size, resize_to, int(normalize),
+        out.ctypes.data_as(ctypes.c_void_p),
+        fail.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n_threads)
+    return out, list(np.nonzero(fail)[0])
+
+
+def jpeg_size_bytes(data: bytes) -> Optional[Tuple[int, int]]:
+    """(width, height) from an in-memory JPEG header, or None on failure."""
+    if not mem_available():
+        return None
+    lib = _native.load()
+    w, h = ctypes.c_int(), ctypes.c_int()
+    if lib.vitax_jpeg_size_mem(data, len(data), ctypes.byref(w),
+                               ctypes.byref(h)) != 0:
+        return None
+    return w.value, h.value
+
+
+def process_bytes(data: bytes, params: Sequence[int], out_size: int,
+                  resize_to: int, normalize: bool = True
+                  ) -> Optional[np.ndarray]:
+    """Decode + transform one in-memory JPEG (a shard record or a /predict
+    request body) — same pipeline and bitwise-identical output to
+    process_file on the same bytes. Returns None on failure or when the
+    memory-source API is unavailable (caller falls back to PIL)."""
+    if not mem_available():
+        return None
+    lib = _native.load()
+    out = np.empty((out_size, out_size, 3),
+                   np.float32 if normalize else np.uint8)
+    mode, left, top, cw, ch, flip = (int(x) for x in params)
+    rc = lib.vitax_process_mem(
+        data, len(data), mode, left, top, cw, ch, flip, out_size, resize_to,
+        int(normalize), out.ctypes.data_as(ctypes.c_void_p))
+    return out if rc == 0 else None
+
+
+def process_batch_bytes(blobs: Sequence[bytes],
+                        params: Sequence[Sequence[int]], out_size: int,
+                        resize_to: int, n_threads: int = 8,
+                        normalize: bool = True
+                        ) -> Tuple[Optional[np.ndarray], List[int]]:
+    """Decode + transform a batch of in-memory JPEG records on the C++ thread
+    pool — the streaming data plane's hot path (one GIL-free call per local
+    batch, no per-record Python and no filesystem round-trip).
+
+    Same contract as process_batch: (batch, failed_indices), or
+    (None, all indices) when the memory-source API is unavailable.
+    """
+    n = len(blobs)
+    if not mem_available():
+        return None, list(range(n))
+    lib = _native.load()
+    out = np.empty((n, out_size, out_size, 3),
+                   np.float32 if normalize else np.uint8)
+    fail = np.zeros(n, np.uint8)
+    params_arr = np.ascontiguousarray(params, np.int32).reshape(n, 6)
+    # c_char_p conversion keeps a pointer to each bytes object's buffer (the
+    # array holds references); embedded NULs are fine — lengths are explicit
+    c_blobs = (ctypes.c_char_p * n)(*blobs)
+    lens = np.asarray([len(b) for b in blobs], np.int32)
+    lib.vitax_process_batch_mem(
+        c_blobs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+        params_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         out_size, resize_to, int(normalize),
         out.ctypes.data_as(ctypes.c_void_p),
         fail.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n_threads)

@@ -1,0 +1,54 @@
+"""vitax.data.stream — sharded streaming data plane (ROADMAP item 3).
+
+A WebDataset/ArrayRecord-style input subsystem: ImageFolder trees are packed
+once into `.vtxshard` containers (tools/make_shards.py), then training
+streams length-prefixed records sequentially — no per-file opens, native
+in-memory JPEG decode, deterministic per-host shard assignment, and a
+checkpointable mid-epoch resume cursor.
+
+Selected with `--data_format stream` (`--data_dir` points at the shard root);
+`build_stream_datasets` is the `build_datasets` (vitax/data/loader.py)
+counterpart with the same return contract.
+"""
+
+from __future__ import annotations
+
+import os
+
+from jax.sharding import Mesh
+
+from vitax.config import Config
+from vitax.data.stream.format import (ShardFormatError, ShardReader,
+                                      ShardWriter, load_split_meta)
+from vitax.data.stream.loader import StreamDataset, StreamLoader
+from vitax.data.stream.sampler import StreamSampler, assign_shards
+
+__all__ = [
+    "ShardFormatError", "ShardReader", "ShardWriter", "StreamDataset",
+    "StreamLoader", "StreamSampler", "assign_shards",
+    "build_stream_datasets", "load_split_meta",
+]
+
+
+def build_stream_datasets(cfg: Config, mesh: Mesh):
+    """(train_ds, train_loader, val_ds, val_loader) over a shard root —
+    the `--data_format stream` branch of vitax.data.build_datasets."""
+    from vitax.data.transforms import train_transform, val_transform
+
+    norm_on_host = not cfg.device_normalize
+    train_ds = StreamDataset(
+        os.path.join(cfg.data_dir, "train"),
+        train_transform(cfg.image_size, cfg.seed, normalize=norm_on_host))
+    val_ds = StreamDataset(
+        os.path.join(cfg.data_dir, "val"),
+        val_transform(cfg.image_size, normalize=norm_on_host))
+    train_sampler = StreamSampler(train_ds.meta, cfg.batch_size,
+                                  shuffle=True, seed=cfg.seed)
+    val_sampler = StreamSampler(val_ds.meta, cfg.batch_size,
+                                shuffle=False, seed=cfg.seed)
+    train_loader = StreamLoader(train_ds, train_sampler, mesh,
+                                cfg.num_workers,
+                                prefetch=cfg.stream_prefetch)
+    val_loader = StreamLoader(val_ds, val_sampler, mesh, cfg.num_workers,
+                              prefetch=cfg.stream_prefetch)
+    return train_ds, train_loader, val_ds, val_loader

@@ -1,0 +1,280 @@
+"""`.vtxshard` container format: writer, index, and a seeking record reader.
+
+The sharded streaming data plane replaces per-file directory scans (ImageFolder:
+one open()+stat() per sample, millions of tiny reads per epoch) with a
+WebDataset/ArrayRecord-style layout a pod can actually feed from:
+
+    <root>/<split>/shard-00000.vtxshard        length-prefixed records
+    <root>/<split>/shard-00000.vtxshard.json   per-shard index (offsets, labels)
+    <root>/<split>/stream_meta.json            split manifest (classes, shards)
+
+Shard file layout (version 1):
+
+    magic  b"VTXSHARD1\\n"                      (10 bytes)
+    record := uint32le payload_len | int32le label | payload bytes
+    ... repeated; payloads are the ORIGINAL image file bytes, verbatim
+    (JPEGs stay JPEGs — no re-encode, so streaming and ImageFolder deliver
+    bit-identical samples; non-JPEG records fall back to PIL at decode time).
+
+The per-shard JSON index carries record offsets (of each record header from
+the start of the file), payload lengths and labels, so a reader can both
+stream sequentially and seek to an epoch-shuffled record order with ONE open
+file handle per shard. The record header is re-validated against the index on
+every read — a torn or truncated shard fails loudly at the record that hit
+it, not with garbage pixels.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import BinaryIO, Dict, List, Optional, Tuple
+
+from vitax import faults
+
+MAGIC = b"VTXSHARD1\n"
+FORMAT_VERSION = 1
+META_NAME = "stream_meta.json"
+SHARD_SUFFIX = ".vtxshard"
+INDEX_SUFFIX = ".vtxshard.json"
+
+_HEADER = struct.Struct("<Ii")  # payload_len (uint32), label (int32)
+
+DEFAULT_SHARD_SIZE_MB = 100
+
+
+class ShardFormatError(RuntimeError):
+    """A shard file or index that violates the container format — the torn /
+    truncated / wrong-magic cases a crash mid-write or a partial copy leaves
+    behind."""
+
+
+class ShardWriter:
+    """Packs records into size-targeted shards under `split_dir`.
+
+    Usage:
+        with ShardWriter(split_dir, classes=[...]) as w:
+            w.add(payload_bytes, label)
+        # -> shard-*.vtxshard + per-shard indexes + stream_meta.json
+    """
+
+    def __init__(self, split_dir: str, classes: Optional[List[str]] = None,
+                 shard_size_mb: float = DEFAULT_SHARD_SIZE_MB):
+        assert shard_size_mb > 0, "shard size target must be positive"
+        self.split_dir = split_dir
+        self.classes = list(classes) if classes else []
+        self.target_bytes = int(shard_size_mb * 1024 * 1024)
+        os.makedirs(split_dir, exist_ok=True)
+        self._shards: List[Dict] = []   # manifest entries
+        self._f: Optional[BinaryIO] = None
+        self._offsets: List[int] = []
+        self._lengths: List[int] = []
+        self._labels: List[int] = []
+        self._pos = 0
+
+    def _shard_name(self, i: int) -> str:
+        return f"shard-{i:05d}{SHARD_SUFFIX}"
+
+    def _open_shard(self) -> None:
+        name = self._shard_name(len(self._shards))
+        self._f = open(os.path.join(self.split_dir, name), "wb")
+        self._f.write(MAGIC)
+        self._pos = len(MAGIC)
+        self._offsets, self._lengths, self._labels = [], [], []
+
+    def _close_shard(self) -> None:
+        if self._f is None:
+            return
+        self._f.close()
+        name = self._shard_name(len(self._shards))
+        index = {
+            "version": FORMAT_VERSION,
+            "records": len(self._offsets),
+            "offsets": self._offsets,
+            "lengths": self._lengths,
+            "labels": self._labels,
+            "bytes": self._pos,
+        }
+        # atomic index write: the shard becomes visible to readers only once
+        # its index exists, and never half-written
+        idx_path = os.path.join(self.split_dir, name[:-len(SHARD_SUFFIX)]
+                                + INDEX_SUFFIX)
+        tmp = idx_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as jf:
+            json.dump(index, jf)
+        os.replace(tmp, idx_path)
+        self._shards.append({"name": name, "records": len(self._offsets),
+                             "bytes": self._pos})
+        self._f = None
+
+    def add(self, payload: bytes, label: int) -> None:
+        if self._f is None:
+            self._open_shard()
+        self._offsets.append(self._pos)
+        self._lengths.append(len(payload))
+        self._labels.append(int(label))
+        self._f.write(_HEADER.pack(len(payload), int(label)))
+        self._f.write(payload)
+        self._pos += _HEADER.size + len(payload)
+        if self._pos >= self.target_bytes:
+            self._close_shard()
+
+    def close(self) -> Dict:
+        """Finalize the open shard and write the split manifest; returns it."""
+        self._close_shard()
+        meta = {
+            "version": FORMAT_VERSION,
+            "classes": self.classes,
+            "num_records": sum(s["records"] for s in self._shards),
+            "shards": self._shards,
+        }
+        meta_path = os.path.join(self.split_dir, META_NAME)
+        tmp = meta_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, meta_path)
+        return meta
+
+    def __enter__(self) -> "ShardWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        elif self._f is not None:
+            self._f.close()  # leave no dangling handle; partial shard has no
+            # index so readers never see it
+
+
+def load_split_meta(split_dir: str) -> Dict:
+    """The split manifest, validated. Raises FileNotFoundError when the dir
+    holds no stream_meta.json (the config check that `--data_format stream`
+    actually points at a shard set)."""
+    path = os.path.join(split_dir, META_NAME)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {META_NAME} under {split_dir!r} — not a vitax shard "
+            f"directory (build one with tools/make_shards.py)")
+    with open(path) as f:
+        meta = json.load(f)
+    if meta.get("version") != FORMAT_VERSION:
+        raise ShardFormatError(
+            f"{path}: format version {meta.get('version')!r}, reader "
+            f"supports {FORMAT_VERSION}")
+    if not meta.get("shards"):
+        raise ShardFormatError(f"{path}: empty shard list")
+    return meta
+
+
+def load_shard_index(split_dir: str, shard_name: str) -> Dict:
+    path = os.path.join(split_dir,
+                        shard_name[:-len(SHARD_SUFFIX)] + INDEX_SUFFIX)
+    with open(path) as f:
+        index = json.load(f)
+    if index.get("version") != FORMAT_VERSION:
+        raise ShardFormatError(
+            f"{path}: format version {index.get('version')!r}, reader "
+            f"supports {FORMAT_VERSION}")
+    return index
+
+
+class ShardReader:
+    """Seeking record reader over one split: ONE open file handle at a time,
+    records fetched by (shard_id, record_id) with header-vs-index validation.
+
+    The access pattern the epoch plan produces is sequential over shards
+    (shard k is fully consumed before shard k+1) with shuffled offsets inside
+    the open shard — so the reader is a current-handle cache, not a pool.
+    Opens run through the `stream_read` fault site (vitax/faults.py) and are
+    retried once before surfacing, so a transient NFS hiccup costs one
+    reopen, not the run.
+    """
+
+    def __init__(self, split_dir: str, meta: Optional[Dict] = None):
+        self.split_dir = split_dir
+        self.meta = meta if meta is not None else load_split_meta(split_dir)
+        self.shards = self.meta["shards"]
+        self._indexes: Dict[int, Dict] = {}
+        self._f: Optional[BinaryIO] = None
+        self._open_shard_id: Optional[int] = None
+
+    def index(self, shard_id: int) -> Dict:
+        idx = self._indexes.get(shard_id)
+        if idx is None:
+            idx = load_shard_index(self.split_dir,
+                                   self.shards[shard_id]["name"])
+            self._indexes[shard_id] = idx
+        return idx
+
+    def _open(self, shard_id: int) -> BinaryIO:
+        if self._open_shard_id == shard_id and self._f is not None:
+            return self._f
+        self.close()
+        path = os.path.join(self.split_dir, self.shards[shard_id]["name"])
+        last_err: Optional[OSError] = None
+        for attempt in (0, 1):  # one retry: transient open failures happen
+            # on shared stores; a second failure is a real torn/missing shard
+            try:
+                faults.fire("stream_read")  # drill point: `oserror` here
+                # exercises the retry, `stall` starves the consumer like a
+                # slow store
+                f = open(path, "rb")
+            except OSError as e:
+                last_err = e
+                continue
+            magic = f.read(len(MAGIC))
+            if magic != MAGIC:
+                f.close()
+                raise ShardFormatError(
+                    f"{path}: bad magic {magic!r} — torn or not a "
+                    f"{SHARD_SUFFIX} file")
+            self._f = f
+            self._open_shard_id = shard_id
+            return f
+        from vitax.data.loader import LoaderWorkerError
+        raise LoaderWorkerError(
+            f"shard open failed after retry: {path} "
+            f"({type(last_err).__name__}: {last_err})") from last_err
+
+    def read_record(self, shard_id: int, record_id: int) -> Tuple[bytes, int]:
+        """(payload bytes, label) for one record, header-validated."""
+        idx = self.index(shard_id)
+        f = self._open(shard_id)
+        offset = idx["offsets"][record_id]
+        f.seek(offset)
+        header = f.read(_HEADER.size)
+        if len(header) != _HEADER.size:
+            raise ShardFormatError(
+                f"{self.shards[shard_id]['name']}: truncated record header "
+                f"at offset {offset} (record {record_id})")
+        length, label = _HEADER.unpack(header)
+        if (length != idx["lengths"][record_id]
+                or label != idx["labels"][record_id]):
+            raise ShardFormatError(
+                f"{self.shards[shard_id]['name']}: record {record_id} header "
+                f"(len={length}, label={label}) disagrees with index "
+                f"(len={idx['lengths'][record_id]}, "
+                f"label={idx['labels'][record_id]}) — torn shard or stale "
+                f"index")
+        payload = f.read(length)
+        if len(payload) != length:
+            raise ShardFormatError(
+                f"{self.shards[shard_id]['name']}: truncated payload for "
+                f"record {record_id} (wanted {length} bytes, got "
+                f"{len(payload)})")
+        return payload, label
+
+    def iter_shard(self, shard_id: int):
+        """Sequential (payload, label) stream over one shard — the pure
+        streaming path (writer order, no index-driven seeks between
+        records)."""
+        n = self.shards[shard_id]["records"]
+        for record_id in range(n):
+            yield self.read_record(shard_id, record_id)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+            self._open_shard_id = None

@@ -1,0 +1,175 @@
+"""Deterministic shard->host assignment, epoch plans, and resume-cursor math.
+
+Same contract as ShardedSampler (vitax/data/loader.py) at shard granularity:
+
+- **Disjoint**: each shard belongs to exactly one host, by a STATIC
+  assignment derived from the mesh/process topology (process_index,
+  process_count) and the shard manifest — never from the epoch. A static
+  assignment keeps steps_per_epoch identical across epochs and makes the
+  epoch plan a pure function of (seed, epoch), which is what the resume
+  cursor depends on.
+- **Epoch-seeded shuffle**: each epoch permutes the host's shard ORDER and
+  every shard's internal record order from SeedSequence-derived streams, so
+  the plan is reproducible on any restart of the same config.
+
+The cursor: a host consumes its epoch plan strictly in order, so the resume
+position after `step` consumed batches is the single integer
+p = step * local_batch, equivalently `(shard_cursor, record_offset)` into the
+epoch's ordered shard list. Both directions are pure functions of
+(seed, epoch, step) — the checkpoint sidecar stores the tuple form for
+drift detection (a changed shard set between runs fails loudly instead of
+silently feeding different records).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def assign_shards(record_counts: Sequence[int], process_count: int
+                  ) -> List[List[int]]:
+    """Static greedy-balanced shard assignment: shards (largest first,
+    shard id as tie-break) go to the currently lightest host (host id as
+    tie-break). Deterministic in the manifest order, independent of epoch.
+    Returns per-host lists of shard ids, disjoint and jointly exhaustive."""
+    assert process_count >= 1
+    hosts: List[List[int]] = [[] for _ in range(process_count)]
+    loads = [0] * process_count
+    order = sorted(range(len(record_counts)),
+                   key=lambda i: (-record_counts[i], i))
+    for shard_id in order:
+        h = min(range(process_count), key=lambda j: (loads[j], j))
+        hosts[h].append(shard_id)
+        loads[h] += record_counts[shard_id]
+    for h in hosts:
+        h.sort()
+    return hosts
+
+
+class StreamSampler:
+    """Per-host epoch plans over a shard manifest (ShardedSampler parity at
+    shard granularity, plus the resume-cursor math)."""
+
+    def __init__(self, meta: Dict, global_batch: int, shuffle: bool,
+                 seed: int, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        import jax
+        self.shards = meta["shards"]
+        self.shuffle = shuffle
+        self.seed = seed
+        self.global_batch = global_batch
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        assert global_batch % self.process_count == 0
+        self.local_batch = global_batch // self.process_count
+        self.record_counts = [int(s["records"]) for s in self.shards]
+        # global record ids (for the per-sample augmentation rng): record r of
+        # shard s has id shard_base[s] + r — stable across epochs and hosts,
+        # playing the role ImageFolder's dataset index plays
+        self.shard_base = np.concatenate(
+            ([0], np.cumsum(self.record_counts)))[:-1].astype(np.int64)
+        self.assignment = assign_shards(self.record_counts,
+                                        self.process_count)
+        self.my_shards = self.assignment[self.process_index]
+        host_records = [sum(self.record_counts[i] for i in a)
+                        for a in self.assignment]
+        # drop_last at the host level: every host must deliver the SAME step
+        # count (the global batch is a collective), so the epoch length is
+        # pinned by the lightest host. With shards balanced by assign_shards
+        # and a divisible dataset this equals dataset_len // global_batch —
+        # ShardedSampler parity.
+        self.steps_per_epoch = min(hr // self.local_batch
+                                   for hr in host_records)
+
+    def shard_order(self, epoch: int) -> List[int]:
+        """This host's shards in epoch-consumption order."""
+        if not self.shuffle or len(self.my_shards) <= 1:
+            return list(self.my_shards)
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [self.seed, epoch, 1, self.process_index]))
+        return [self.my_shards[i]
+                for i in rng.permutation(len(self.my_shards))]
+
+    def record_order(self, epoch: int, shard_id: int) -> np.ndarray:
+        """Within-shard record order for `epoch` (host-agnostic: keyed on the
+        shard id, so the permutation survives assignment changes)."""
+        n = self.record_counts[shard_id]
+        if not self.shuffle:
+            return np.arange(n, dtype=np.int64)
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [self.seed, epoch, 2, shard_id]))
+        return rng.permutation(n).astype(np.int64)
+
+    def epoch_entries(self, epoch: int) -> np.ndarray:
+        """(steps_per_epoch, local_batch, 2) int64 of (shard_id, record_id):
+        this host's full epoch plan, shards consumed strictly in order (the
+        reader keeps ONE open handle), records within each shard in the
+        epoch's permutation, truncated to whole local batches (drop_last)."""
+        parts = []
+        for shard_id in self.shard_order(epoch):
+            rec = self.record_order(epoch, shard_id)
+            ids = np.full_like(rec, shard_id)
+            parts.append(np.stack([ids, rec], axis=1))
+        flat = (np.concatenate(parts) if parts
+                else np.empty((0, 2), np.int64))
+        usable = self.steps_per_epoch * self.local_batch
+        return flat[:usable].reshape(self.steps_per_epoch, self.local_batch, 2)
+
+    def global_id(self, shard_id: int, record_id: int) -> int:
+        return int(self.shard_base[shard_id]) + int(record_id)
+
+    def cursor_for_step(self, epoch: int, step: int) -> Dict:
+        """The resume cursor after `step` consumed batches of `epoch`: where
+        in the ordered shard list the NEXT record comes from. Stored in the
+        checkpoint sidecar by train/loop.py; the resume itself re-derives the
+        position from (seed, epoch, step) and uses this record to detect a
+        drifted shard set."""
+        shard_cursor, record_offset = self._locate(epoch, step)
+        order = self.shard_order(epoch)
+        shard_name = (self.shards[order[shard_cursor]]["name"]
+                      if shard_cursor < len(order) else None)
+        return {
+            "epoch": int(epoch),
+            "step": int(step),
+            "shard_cursor": int(shard_cursor),
+            "record_offset": int(record_offset),
+            "shard": shard_name,
+            "process_index": int(self.process_index),
+            "process_count": int(self.process_count),
+        }
+
+    def _locate(self, epoch: int, step: int) -> Tuple[int, int]:
+        """(shard_cursor, record_offset) for consumed position
+        p = step * local_batch; shard_cursor == len(order) means the epoch's
+        plan is fully consumed."""
+        assert 0 <= step <= self.steps_per_epoch, (
+            f"step {step} outside epoch of {self.steps_per_epoch} steps")
+        p = step * self.local_batch
+        order = self.shard_order(epoch)
+        for j, shard_id in enumerate(order):
+            n = self.record_counts[shard_id]
+            if p < n:
+                return j, p
+            p -= n
+        return len(order), 0
+
+    def check_cursor(self, cursor: Dict, epoch: int, step: int) -> None:
+        """Validate a sidecar cursor against the position this sampler derives
+        for (epoch, step). A mismatch means the shard set, seed, or topology
+        changed since the checkpoint — resuming would silently feed different
+        records, so fail loudly instead."""
+        if int(cursor.get("process_index", self.process_index)) != self.process_index:
+            return  # another host's cursor — not comparable to this plan
+        expect = self.cursor_for_step(epoch, step)
+        for key in ("shard_cursor", "record_offset", "shard"):
+            if cursor.get(key) != expect[key]:
+                raise RuntimeError(
+                    f"stream resume cursor mismatch at epoch {epoch} step "
+                    f"{step}: checkpoint recorded {key}="
+                    f"{cursor.get(key)!r}, current shard set derives "
+                    f"{expect[key]!r} — the shard directory, seed, or "
+                    f"topology changed since the checkpoint was written")

@@ -18,6 +18,9 @@ Sites (each a single host-side hook point; see the wiring modules):
               the save retry path (vitax/checkpoint/orbax_io.py)
   loader      once per produced host batch, on the producer thread
               (vitax/data/loader.py)
+  stream_read once per shard-file open attempt in the streaming reader
+              (vitax/data/stream/format.py) — `oserror` exercises the
+              open-retry-then-LoaderWorkerError path, `stall` a slow store
 
 Actions:
   crash    os._exit(exit_code) — a hard kill: no atexit, no drains, exactly
@@ -47,7 +50,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-SITES = ("step", "ckpt_write", "loader")
+SITES = ("step", "ckpt_write", "loader", "stream_read")
 ACTIONS = ("crash", "hang", "oserror", "stall", "sigterm")
 
 DEFAULT_CRASH_EXIT_CODE = 13

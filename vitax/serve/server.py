@@ -148,6 +148,28 @@ def build_serve_recorder(cfg: Config):
                     device_kind(), rank=0)
 
 
+def decode_image_bytes(raw: bytes, transform):
+    """One /predict image body -> transformed HWC array.
+
+    JPEG bodies route through the native in-memory pipeline
+    (vitax/data/native.py process_bytes — libjpeg decode + the PIL-parity
+    resize, one C call, no per-request Python decode tax); anything else, or
+    a native failure/missing library, falls back to PIL. The two paths apply
+    the SAME eval transform (tests/test_stream.py pins resize-path parity)."""
+    from vitax.data import native
+    if (native.is_jpeg_bytes(raw) and hasattr(transform, "native_params")
+            and native.mem_available()):
+        arr = native.process_bytes(
+            raw, transform.native_params(0, 0, 0), transform.image_size,
+            getattr(transform, "resize_to", 0),
+            normalize=getattr(transform, "normalize", True))
+        if arr is not None:
+            return arr
+    from PIL import Image
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    return transform(img)
+
+
 class ServeContext:
     """Everything a handler thread needs, wired once at startup."""
 
@@ -231,9 +253,7 @@ class ServeContext:
                         f"(--serve_topk caps the compiled top-k)")
         else:
             raw = body
-        from PIL import Image
-        img = Image.open(io.BytesIO(raw)).convert("RGB")
-        return self.transform(img), topk
+        return decode_image_bytes(raw, self.transform), topk
 
     def close(self) -> None:
         self.batcher.close()

@@ -222,6 +222,37 @@ def train(cfg: Config) -> TrainState:
     return state
 
 
+def _stream_cursor(loader, epoch: int, next_step: int):
+    """The streaming data plane's resume cursor after `next_step` consumed
+    batches, or None for loaders without one (ImageFolder/fake). Rides the
+    mid-epoch checkpoint sidecar so the resumed run can validate its derived
+    position against the shard set that produced the checkpoint."""
+    fn = getattr(loader, "cursor_for_step", None)
+    return fn(epoch, next_step) if fn is not None else None
+
+
+def _verify_stream_resume(cfg, train_loader, resume_step: int) -> None:
+    """Mid-epoch stream resume: check the sidecar cursor against the position
+    this run derives from (seed, epoch, step). The derivation is the source
+    of truth — the stored cursor exists to FAIL LOUDLY when the shard set,
+    seed, or topology changed underneath the checkpoint (silently feeding
+    different records is the failure mode). Process 0 only: the sidecar holds
+    process 0's cursor, and a drifted shard manifest is global anyway."""
+    if not resume_step or not hasattr(train_loader, "check_cursor"):
+        return
+    if jax.process_index() != 0:
+        return
+    from vitax.checkpoint.orbax_io import load_stream_cursor
+    cursor = load_stream_cursor(cfg.ckpt_dir, cfg.resume_epoch)
+    if cursor is not None:
+        train_loader.check_cursor(cursor, resume_step)
+        master_print(f"stream resume cursor verified: epoch "
+                     f"{cursor.get('epoch')}, shard_cursor "
+                     f"{cursor.get('shard_cursor')} "
+                     f"({cursor.get('shard')}), record_offset "
+                     f"{cursor.get('record_offset')}")
+
+
 def _preempt_agreed(step_in_epoch) -> bool:
     """Did SIGTERM arrive, as agreed by ALL hosts? Single-host: the local flag
     (free, checked every step). Multi-host: the flag sync is a collective, so
@@ -258,6 +289,7 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
     if resume_step:
         master_print(f"step-granular resume: re-entering epoch {start_epoch} "
                      f"at step {resume_step + 1}")
+        _verify_stream_resume(cfg, train_loader, resume_step)
     for epoch in range(max(start_epoch, 1), cfg.num_epochs + 1):
         master_print(f"starting epoch {epoch}")
         time_epoch_b = time_step_b = time.time()
@@ -344,7 +376,9 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                              f"and exiting with code {EXIT_HANG}")
                 jax.device_get(metrics["loss"])  # fence: step must be done
                 save_state(cfg.ckpt_dir, epoch, state, wait=True,
-                           step_in_epoch=step + 1)
+                           step_in_epoch=step + 1,
+                           stream_cursor=_stream_cursor(train_loader, epoch,
+                                                        step + 1))
                 raise SystemExit(EXIT_HANG)
             if _preempt_agreed(step_in_epoch=step):
                 # commit a synchronous save of the live mid-epoch state under
@@ -356,7 +390,9 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                              f"at epoch {epoch} (step {step + 1}) and exiting")
                 jax.device_get(metrics["loss"])  # fence: step must be done
                 save_state(cfg.ckpt_dir, epoch, state, wait=True,
-                           step_in_epoch=step + 1)
+                           step_in_epoch=step + 1,
+                           stream_cursor=_stream_cursor(train_loader, epoch,
+                                                        step + 1))
                 return state
             if cfg.max_steps and total_steps >= cfg.max_steps:
                 break
