@@ -1,0 +1,785 @@
+"""Coordinated failure control plane tests (PR 10): control-word pack/agree
+semantics, peer-liveness verdicts, elastic (topology-change) resume planning,
+and the wiring around them (watchdog escalation requests, fault-plan process
+gating, supervisor topology detection, metrics surfacing, VTX107).
+
+Unit arms run tier-1 with injected collectives / fake KV clients / fake
+children — no multi-process runtime. The true 2-process drills (agreed
+escalation, peer death, N->M elastic resume) are `slow` subprocess tests on
+the same harness as tests/test_multiprocess.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from vitax import faults
+from vitax.supervise import Supervisor, checkpoint_topology
+from vitax.telemetry.watchdog import EXIT_HANG, Watchdog
+from vitax.train.control import (BIT_ESCALATE, BIT_FAULT, BIT_PEER_LOST,
+                                 BIT_PREEMPT, ControlPlane, PeerLiveness,
+                                 Signals, elastic_resume_plan, pack_word,
+                                 unpack_word)
+
+from tests.test_multiprocess import (_free_port, _tiny_train_argv,
+                                     _two_proc_env)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_flags():
+    """Neither a fault plan nor a delivered-SIGTERM flag may leak across
+    tests (both registries are module-global)."""
+    yield
+    faults.uninstall()
+    from vitax.train import preempt
+    preempt.reset()
+
+
+def _wait_until(cond, timeout_s=5.0, poll_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll_s)
+    return cond()
+
+
+# --- control word: pack / unpack / describe ---------------------------------
+
+def test_pack_unpack_roundtrip_all_combinations():
+    for word in range(16):
+        sig = unpack_word(word)
+        assert sig.word == word
+        assert pack_word(sig.preempt, sig.escalate, sig.fault,
+                         sig.peer_lost) == word
+    assert pack_word(preempt=True) == BIT_PREEMPT == 1
+    assert pack_word(escalate=True) == BIT_ESCALATE == 2
+    assert pack_word(fault=True) == BIT_FAULT == 4
+    assert pack_word(peer_lost=True) == BIT_PEER_LOST == 8
+
+
+@pytest.mark.parametrize("bad", [16, 32, -1, 0x1F, 1 << 40])
+def test_unpack_rejects_unknown_bits(bad):
+    # garbage from a version-skewed peer must fail loudly, not mask to "none"
+    with pytest.raises(ValueError):
+        unpack_word(bad)
+
+
+def test_signals_emergency_and_describe():
+    assert not Signals().any
+    assert Signals().describe() == "none"
+    # preempt alone is the CLEAN drain (exit 0), never the emergency path
+    assert Signals(preempt=True).any
+    assert not Signals(preempt=True).emergency
+    for kw in ({"escalate": True}, {"fault": True}, {"peer_lost": True}):
+        assert Signals(**kw).emergency
+    assert Signals(preempt=True, fault=True).describe() == "preempt+fault"
+    assert unpack_word(pack_word(escalate=True, peer_lost=True)).describe() \
+        == "escalate+peer_lost"
+
+
+# --- ControlPlane: local word folding ---------------------------------------
+
+class _FakeWatchdog:
+    def __init__(self):
+        self.escalated = False
+        self.requests = []
+
+    def escalation_requested(self):
+        return self.escalated
+
+    def request_escalation(self, reason=""):
+        self.escalated = True
+        self.requests.append(reason)
+
+
+def test_local_word_folds_all_four_signals(monkeypatch):
+    wd = _FakeWatchdog()
+    plane = ControlPlane(process_index=0, process_count=1, watchdog=wd)
+    assert plane.local_word() == 0
+    from vitax.train import preempt
+    monkeypatch.setattr(preempt, "requested", lambda: True)
+    assert plane.local_word() == BIT_PREEMPT
+    wd.escalated = True
+    assert plane.local_word() == BIT_PREEMPT | BIT_ESCALATE
+    plane.set_fault("test")
+    plane._peer_lost.set()
+    assert plane.local_word() == (BIT_PREEMPT | BIT_ESCALATE
+                                  | BIT_FAULT | BIT_PEER_LOST)
+
+
+def test_single_host_poll_is_every_step_and_collective_free():
+    # process_count=1: the local word is read on EVERY call (PR 7 semantics
+    # preserved exactly) and no collective ever runs
+    def boom(word):
+        raise AssertionError("single-host poll must not run a collective")
+
+    plane = ControlPlane(sync_steps=10, process_index=0, process_count=1,
+                         collective=boom)
+    for step in range(7):  # all off the sync cadence
+        assert plane.poll(step_in_epoch=step) == Signals()
+    plane.set_fault("boom")
+    assert plane.poll(step_in_epoch=3).fault  # off-cadence, still seen
+    assert plane.poll(step_in_epoch=None).fault
+
+
+# --- ControlPlane: multi-host cadence + OR-fold agreement --------------------
+
+def test_multi_host_cadence_gates_the_collective():
+    calls = []
+
+    def fold(word):
+        calls.append(word)
+        return word
+
+    plane = ControlPlane(sync_steps=5, process_index=0, process_count=2,
+                         collective=fold)
+    plane.set_fault("local")
+    # steps 0..3 are off-cadence: no collective, and the verdict is withheld
+    for step in range(4):
+        assert plane.poll(step_in_epoch=step) == Signals()
+    assert calls == []
+    # step 4 -> (4+1) % 5 == 0: exactly one fold of the local word
+    assert plane.poll(step_in_epoch=4).fault
+    assert calls == [BIT_FAULT]
+    # the epoch boundary always syncs, whatever the step cadence
+    assert plane.poll(step_in_epoch=None).fault
+    assert len(calls) == 2
+
+
+def test_warmup_runs_one_fold_multi_host_and_none_single_host():
+    # warmup pre-compiles the agreement collective OUTSIDE the watchdog's
+    # hang-deadline window (the first fold carries XLA compile + transport
+    # setup); it must fold a zero word and discard the result
+    calls = []
+    plane = ControlPlane(sync_steps=5, process_index=0, process_count=2,
+                         collective=lambda w: calls.append(w) or w)
+    plane.warmup()
+    assert calls == [0]
+
+    def boom(word):
+        raise AssertionError("single-host warmup must not run a collective")
+
+    solo = ControlPlane(process_index=0, process_count=1, collective=boom)
+    solo.warmup()  # no-op
+
+
+def test_agreement_is_a_bitwise_or_across_hosts():
+    # this host has nothing raised; the peer contributes ESCALATE|PREEMPT.
+    # A max() fold would keep only one host's word — OR keeps every bit.
+    peer_word = BIT_PREEMPT | BIT_ESCALATE
+    plane = ControlPlane(sync_steps=1, process_index=1, process_count=2,
+                         collective=lambda w: w | peer_word)
+    sig = plane.poll(step_in_epoch=0)
+    assert sig.preempt and sig.escalate and sig.emergency
+    assert sig.word == peer_word
+
+
+def test_agreed_word_is_announced_once_with_payload():
+    events = []
+    plane = ControlPlane(sync_steps=1, process_index=0, process_count=2,
+                         collective=lambda w: w,
+                         on_event=events.append)
+    plane.set_fault("drill")
+    assert plane.poll(step_in_epoch=4, epoch=2).fault
+    assert plane.poll(step_in_epoch=5, epoch=2).fault  # seen again, not re-announced
+    agreed = [e for e in events if e["event"] == "agreed_escalation"]
+    assert len(agreed) == 1
+    assert agreed[0]["word"] == BIT_FAULT
+    assert agreed[0]["signals"] == "fault"
+    assert agreed[0]["epoch"] == 2 and agreed[0]["step_in_epoch"] == 5
+
+
+def test_preempt_only_announces_the_clean_drain(monkeypatch):
+    from vitax.train import preempt
+    monkeypatch.setattr(preempt, "requested", lambda: True)
+    events = []
+    plane = ControlPlane(sync_steps=1, process_index=0, process_count=2,
+                         collective=lambda w: w, on_event=events.append)
+    sig = plane.poll(step_in_epoch=0)
+    assert sig.preempt and not sig.emergency
+    assert [e["event"] for e in events] == ["agreed_preempt"]
+
+
+def test_barrier_timeout_fault_site_fires_inside_the_agreement():
+    faults.install('{"site": "barrier_timeout", "action": "oserror", "at": 1}')
+    plane = ControlPlane(sync_steps=1, process_index=0, process_count=2,
+                         collective=lambda w: w)
+    with pytest.raises(OSError):
+        plane.poll(step_in_epoch=0)
+
+
+# --- peer liveness -----------------------------------------------------------
+
+class _FakeKV:
+    """In-memory stand-in for the coordination-service KV client."""
+
+    def __init__(self):
+        self.store = {}
+        self.lock = threading.Lock()
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        with self.lock:
+            self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_in_ms):
+        with self.lock:
+            if key in self.store:
+                return self.store[key]
+        raise KeyError(key)
+
+
+def test_liveness_declares_a_silent_peer_lost_once_with_cause():
+    kv = _FakeKV()
+    kv.key_value_set("vitax/fault/1", "hang_hard_exit")
+    losses = []
+    live = PeerLiveness(process_index=0, process_count=2, interval_s=0.05,
+                        grace_s=0.25, client=kv,
+                        on_loss=lambda *a: losses.append(a))
+    live.start()
+    try:
+        # the peer beats for a while: no verdict
+        for seq in range(3):
+            kv.key_value_set("vitax/hb/1", str(seq))
+            time.sleep(0.08)
+        assert losses == []
+        # ...then goes silent: lost after the grace window, exactly once
+        assert _wait_until(lambda: losses, timeout_s=3.0)
+        time.sleep(0.3)
+        assert len(losses) == 1
+        peer, silent_s, cause = losses[0]
+        assert peer == 1 and silent_s >= 0.25
+        assert cause == "hang_hard_exit"
+        assert live.lost == {1}
+        # our own beater side kept writing its key
+        assert "vitax/hb/0" in kv.store
+    finally:
+        live.stop()
+
+
+def test_liveness_flags_a_peer_that_never_wrote_at_all():
+    # death during compile, before the first beat: the grace clock starts at
+    # monitor start, so the verdict still arrives
+    losses = []
+    live = PeerLiveness(process_index=0, process_count=2, interval_s=0.05,
+                        grace_s=0.2, client=_FakeKV(),
+                        on_loss=lambda *a: losses.append(a))
+    live.start()
+    try:
+        assert _wait_until(lambda: losses, timeout_s=3.0)
+        assert losses[0][0] == 1 and losses[0][2] is None
+    finally:
+        live.stop()
+
+
+def test_peer_loss_escalates_and_hard_exits_within_the_deadline():
+    events, exits = [], []
+    wd = _FakeWatchdog()
+    plane = ControlPlane(sync_steps=1, process_index=0, process_count=2,
+                         watchdog=wd, collective=lambda w: w,
+                         on_event=events.append, hard_exit=exits.append)
+    kv = _FakeKV()  # peer 1 never beats: lost after grace
+    assert plane.start_liveness(interval_s=0.05, grace_s=0.2, client=kv)
+    try:
+        assert _wait_until(lambda: exits, timeout_s=5.0)
+    finally:
+        plane.stop()
+    # the verdict raised the sticky bit, asked the watchdog to escalate,
+    # emitted the event, and the independent timer exited EXIT_HANG
+    assert plane.local_word() & BIT_PEER_LOST
+    assert wd.requests and "peer 1 lost" in wd.requests[0]
+    loss = [e for e in events if e["event"] == "peer_loss"]
+    assert len(loss) == 1
+    assert loss[0]["peer"] == 1 and loss[0]["exit_code"] == EXIT_HANG
+    assert exits == [EXIT_HANG]
+
+
+def test_peer_loss_suspected_classifies_collective_errors():
+    # no liveness running: every error is a genuine bug (caller re-raises)
+    assert ControlPlane(process_index=0, process_count=2,
+                        collective=lambda w: w).peer_loss_suspected() is None
+    # liveness running and the peer silent: the error is the death itself —
+    # the classifier waits for the monitor's verdict and names the peer
+    exits = []
+    plane = ControlPlane(sync_steps=1, process_index=0, process_count=2,
+                         collective=lambda w: w, hard_exit=exits.append)
+    assert plane.start_liveness(interval_s=0.05, grace_s=0.2,
+                                client=_FakeKV())
+    try:
+        assert plane.peer_loss_suspected() == 1
+    finally:
+        plane.stop()
+
+
+def test_peer_loss_suspected_none_while_peers_keep_beating():
+    kv = _FakeKV()
+    plane = ControlPlane(sync_steps=1, process_index=0, process_count=2,
+                         collective=lambda w: w)
+    assert plane.start_liveness(interval_s=0.05, grace_s=10.0, client=kv)
+    try:
+        # a healthy peer beats throughout: the classifier must not blame it
+        stop = threading.Event()
+
+        def beat():
+            seq = 0
+            while not stop.is_set():
+                seq += 1
+                kv.key_value_set("vitax/hb/1", str(seq))
+                time.sleep(0.02)
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        try:
+            assert plane.peer_loss_suspected(wait=False) is None
+        finally:
+            stop.set()
+            t.join()
+    finally:
+        plane.stop()
+
+
+def test_liveness_refused_without_peers_or_client():
+    plane = ControlPlane(process_index=0, process_count=1)
+    assert plane.start_liveness(0.1, 1.0, client=_FakeKV()) is False
+    plane2 = ControlPlane(process_index=0, process_count=2)
+    # no coordination service reachable in-process: off, loudly, not fatal
+    assert plane2.start_liveness(0.1, 1.0) is False
+
+
+# --- elastic resume planning -------------------------------------------------
+
+def test_elastic_resume_plan_no_meta_is_epoch_boundary():
+    plan = elastic_resume_plan(None, process_count=4)
+    assert plan.resume_step == 0 and not plan.topology_changed
+    assert not plan.epoch_rounded and plan.from_processes == 0
+
+
+def test_elastic_resume_plan_same_topology_is_exact():
+    meta = {"step_in_epoch": 7, "process_count": 2,
+            "stream_cursor": {"shard": "s0", "record_offset": 3}}
+    plan = elastic_resume_plan(meta, process_count=2)
+    assert plan.resume_step == 7
+    assert not plan.topology_changed and not plan.epoch_rounded
+
+
+def test_elastic_resume_plan_topology_change_without_cursor_is_exact():
+    # index-sampled loaders partition rank-interleaved: step-exact under N->M
+    plan = elastic_resume_plan({"step_in_epoch": 7, "process_count": 2},
+                               process_count=1)
+    assert plan.topology_changed and not plan.epoch_rounded
+    assert plan.resume_step == 7 and plan.from_processes == 2
+
+
+def test_elastic_resume_plan_topology_change_with_cursor_rounds_down():
+    # a stream cursor's shard assignment is disjoint per topology: N->M must
+    # re-enter at the epoch boundary, loudly dropping the partial progress
+    meta = {"step_in_epoch": 7, "process_count": 2,
+            "stream_cursor": {"shard": "s0", "record_offset": 3}}
+    plan = elastic_resume_plan(meta, process_count=1)
+    assert plan.topology_changed and plan.epoch_rounded
+    assert plan.resume_step == 0 and plan.skipped_steps == 7
+
+
+def test_elastic_resume_plan_tolerates_pre_pr10_sidecars():
+    # sidecars written before process_count existed: never "changed"
+    plan = elastic_resume_plan({"step_in_epoch": 4}, process_count=8)
+    assert plan.resume_step == 4 and not plan.topology_changed
+
+
+def test_sidecar_records_topology_and_checkpoint_topology_reads_it(tmp_path):
+    import numpy as np
+    from vitax.checkpoint.orbax_io import load_resume_meta, save_state
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    save_state(str(tmp_path), 1, tree, wait=True, step_in_epoch=3)
+    meta = load_resume_meta(str(tmp_path), 1)
+    assert meta["step_in_epoch"] == 3
+    assert meta["process_count"] == 1  # single-process test runtime
+    assert checkpoint_topology(str(tmp_path)) == 1
+    # a boundary save has no sidecar: topology unknown, not "changed"
+    save_state(str(tmp_path), 2, tree, wait=True)
+    assert checkpoint_topology(str(tmp_path)) is None
+
+
+# --- fault-plan process designation ------------------------------------------
+
+def test_fault_spec_process_gates_by_process_index(monkeypatch):
+    plan = ('{"site": "step", "action": "oserror", "at": 1, "times": 99, '
+            '"process": 1}')
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    faults.install(plan)
+    faults.fire("step", index=1)  # designated for process 1: silent here
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    with pytest.raises(OSError):
+        faults.fire("step", index=2)
+
+
+def test_fault_spec_process_validation_and_describe():
+    spec = faults.FaultSpec(site="step", action="peer_loss", at=6, process=1)
+    assert spec.describe() == "step:peer_loss@p1(at=6)"
+    assert "peer_loss" in faults.ACTIONS
+    assert "barrier_timeout" in faults.SITES
+    with pytest.raises(ValueError):
+        faults.FaultSpec(site="step", action="crash", process=-2)
+    parsed = faults.parse_plan(
+        '{"site": "barrier_timeout", "action": "hang", "process": 0}')
+    assert parsed.specs[0].process == 0
+
+
+# --- watchdog: external escalation + last-words hook -------------------------
+
+def test_watchdog_request_escalation_arms_flag_and_deadline():
+    escalations, exits = [], []
+    wd = Watchdog(timeout_s=100.0, poll_s=0.02, action="checkpoint_exit",
+                  hard_deadline_s=0.15, on_escalate=escalations.append,
+                  hard_exit=exits.append).start()
+    try:
+        assert not wd.escalation_requested()
+        wd.request_escalation("peer 1 lost (heartbeat silent 2.0s)")
+        assert wd.escalation_requested()
+        wd.request_escalation("again")  # idempotent: one escalation event
+        assert len(escalations) == 1
+        assert escalations[0]["reason"].startswith("peer 1 lost")
+        assert escalations[0]["exit_code"] == EXIT_HANG
+        # the loop never acknowledges: the hard deadline bounds the exit
+        assert _wait_until(lambda: exits == [EXIT_HANG], timeout_s=3.0)
+    finally:
+        wd.stop()
+
+
+def test_watchdog_hard_exit_speaks_last_words_first():
+    order = []
+    wd = Watchdog(timeout_s=0.05, poll_s=0.02, action="checkpoint_exit",
+                  hard_deadline_s=0.1, rank=3,
+                  on_hard_exit=lambda p: order.append(("words", p)),
+                  hard_exit=lambda code: order.append(("exit", code))).start()
+    try:
+        assert _wait_until(lambda: ("exit", EXIT_HANG) in order, timeout_s=3.0)
+    finally:
+        wd.stop()
+    words = [p for tag, p in order if tag == "words"]
+    assert words and words[0]["exit_code"] == EXIT_HANG
+    assert words[0]["rank"] == 3
+    # the hook ran BEFORE the exit, so a real run's flushed telemetry event
+    # and fault publication land even under os._exit
+    assert order.index(("words", words[0])) < order.index(("exit", EXIT_HANG))
+
+
+# --- supervisor: elastic (topology-change) restart detection -----------------
+
+class _DoneChild:
+    def __init__(self, rc=0):
+        self.rc = rc
+
+    def poll(self):
+        return self.rc
+
+
+def _control_events(metrics_dir):
+    path = os.path.join(str(metrics_dir), "metrics.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(ln) for ln in open(path, encoding="utf-8")
+            if json.loads(ln).get("kind") == "control"]
+
+
+def test_supervisor_announces_topology_change_before_launch(tmp_path):
+    sup = Supervisor(["python", "train.py"], ckpt_dir=str(tmp_path),
+                     metrics_dir=str(tmp_path),
+                     spawn=lambda argv: _DoneChild(0),
+                     progress_fn=lambda: (0, 0), sleep=lambda s: None,
+                     expect_processes=1, topology_fn=lambda: 2)
+    assert sup.run() == 0
+    assert sup.topology_changes == 1
+    events = _control_events(tmp_path)
+    assert len(events) == 1
+    assert events[0]["event"] == "topology_change"
+    assert events[0]["from_processes"] == 2
+    assert events[0]["to_processes"] == 1
+
+
+def test_supervisor_topology_check_quiet_when_matching_or_off(tmp_path):
+    for expect, recorded in ((1, 1), (1, None), (0, 7)):
+        sup = Supervisor(["python", "t.py"], ckpt_dir=str(tmp_path),
+                         metrics_dir=str(tmp_path / f"m{expect}_{recorded}"),
+                         spawn=lambda argv: _DoneChild(0),
+                         progress_fn=lambda: (0, 0), sleep=lambda s: None,
+                         expect_processes=expect,
+                         topology_fn=lambda r=recorded: r)
+        assert sup.run() == 0
+        assert sup.topology_changes == 0
+        assert _control_events(tmp_path / f"m{expect}_{recorded}") == []
+
+
+def test_supervisor_announces_each_distinct_mismatch_once(tmp_path):
+    children = iter([_DoneChild(13), _DoneChild(0)])
+    progresses = iter([(0, 0), (1, 0), (1, 0)])
+    sup = Supervisor(["python", "t.py"], ckpt_dir=str(tmp_path),
+                     metrics_dir=str(tmp_path),
+                     spawn=lambda argv: next(children),
+                     progress_fn=lambda: next(progresses),
+                     sleep=lambda s: None,
+                     expect_processes=1, topology_fn=lambda: 4)
+    assert sup.run() == 0
+    # two launches saw the same recorded topology: one announcement
+    assert sup.restart_count == 1 and sup.topology_changes == 1
+    assert len(_control_events(tmp_path)) == 1
+
+
+# --- metrics_report: control-plane counters ----------------------------------
+
+def test_metrics_report_folds_control_events(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    records = [
+        {"schema": 1, "step": 1, "loss": 2.0, "sec_per_iter": 0.1},
+        {"schema": 1, "kind": "control", "event": "agreed_preempt", "word": 1},
+        {"schema": 1, "kind": "control", "event": "agreed_escalation",
+         "word": 2},
+        {"schema": 1, "kind": "control", "event": "peer_loss", "peer": 1},
+        {"schema": 1, "kind": "control", "event": "topology_change",
+         "from_processes": 2, "to_processes": 1},
+        {"schema": 1, "kind": "control", "event": "elastic_resume",
+         "from_processes": 2, "to_processes": 1, "resume_step": 12},
+        {"schema": 1, "kind": "hang_hard_exit", "exit_code": 42},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "metrics_report.py"),
+         str(path), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["control_events"] == {
+        "agreed_preemptions": 1, "agreed_escalations": 1,
+        "peer_loss_detections": 1, "topology_changes": 1,
+        "elastic_resumes": 1}
+    assert summary["hang_hard_exits"] == 1
+
+    human = subprocess.run(
+        [sys.executable, os.path.join("tools", "metrics_report.py"),
+         str(path)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert human.returncode == 0
+    assert ("control plane: 1 agreed preemption(s), 1 agreed escalation(s), "
+            "1 peer loss(es), 1 topology change(s), "
+            "1 elastic resume(s)") in human.stdout
+    assert "watchdog hard-deadline exits: 1" in human.stdout
+
+
+# --- VTX107: raw failure-signal polls are fenced to the control plane --------
+
+def test_ast_lint_vtx107_flags_raw_signal_polls():
+    from vitax.analysis import ast_lint
+
+    def _codes(findings):
+        return [f.code for f in findings]
+
+    src = ("from vitax.train import preempt\n"
+           "def loop(wd):\n"
+           "    if preempt.requested():\n"
+           "        return 1\n"
+           "    if wd.escalation_requested():\n"
+           "        return 2\n")
+    assert _codes(ast_lint.lint_source(src, "vitax/train/foo.py")) == \
+        ["VTX107", "VTX107"]
+
+    suppressed = (
+        "from vitax.train import preempt\n"
+        "def loop(wd):\n"
+        "    if preempt.requested():  # vtx: ignore[VTX107] sanctioned\n"
+        "        return 1\n")
+    assert ast_lint.lint_source(suppressed, "vitax/train/foo.py") == []
+
+    # a bare name (not an attribute access) is not the fenced pattern
+    plain = ("def loop(escalation_requested):\n"
+             "    return escalation_requested()\n")
+    assert ast_lint.lint_source(plain, "vitax/train/foo.py") == []
+
+
+def test_control_module_itself_passes_the_ast_lint():
+    # the two sanctioned raw polls in ControlPlane.local_word carry reasons
+    from vitax.analysis import ast_lint
+    path = os.path.join(REPO, "vitax", "train", "control.py")
+    with open(path, encoding="utf-8") as f:
+        findings = ast_lint.lint_source(f.read(), "vitax/train/control.py")
+    assert findings == []
+
+
+# --- step-program identity: the control plane is host-side only --------------
+
+def test_control_knobs_trace_identical_step_program(devices8):
+    """--control_sync_steps / --peer_heartbeat_s are host-side machinery:
+    the lowered train-step program must be bit-identical with them at any
+    setting (same acceptance pin faults and telemetry carry)."""
+    import jax
+    from tests.test_checkpoint import tiny_cfg
+    from tests.test_train_smoke import build_train_objects, random_batch
+
+    def lowered(cfg):
+        mesh, state, step_fn, _ = build_train_objects(cfg)
+        batch = random_batch(cfg, mesh)
+        return step_fn.lower(state, batch, jax.random.key(0)).as_text()
+
+    off = lowered(tiny_cfg())
+    on = lowered(tiny_cfg(control_sync_steps=3, peer_heartbeat_s=0.5,
+                          peer_grace_s=2.0))
+    assert off == on
+
+
+# --- slow 2-process drills ---------------------------------------------------
+
+def _spawn_two(argv, port, tmp_path, extra_env=None):
+    """Start the same argv as 2 coordinated processes with per-rank log
+    files; returns (procs, logs). Caller owns waiting + cleanup."""
+    logs = [tmp_path / f"rank{i}.log" for i in range(2)]
+    procs = []
+    for pid in range(2):
+        env = _two_proc_env(port, pid)
+        env.update(extra_env or {})
+        with open(logs[pid], "w") as log_f:
+            procs.append(subprocess.Popen(
+                argv, cwd=REPO, env=env, stdout=log_f,
+                stderr=subprocess.STDOUT, text=True))
+    return procs, logs
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+
+
+@pytest.mark.slow
+def test_two_process_agreed_escalation_exits_42_at_the_same_step(tmp_path):
+    """A hang on ONE host must take down BOTH hosts through the agreed
+    emergency path: host 0's watchdog escalates locally, the next control
+    sync folds ESCALATE into the agreed word, and both processes commit the
+    SAME mid-epoch checkpoint and exit EXIT_HANG (42) — the supervisor then
+    sees one uniform verdict instead of one wedged and one dead host."""
+    port = _free_port()
+    # timing: per-step jitter on 2-proc CPU/Gloo can top 1s, so the timeout
+    # must clear it (3s) and the injected hang must clear the timeout (7s)
+    # while the agreement lands inside the hard deadline (escalation ~+3s,
+    # deadline 2x3s later at ~+9s, wake at +7s -> ~2s of margin)
+    plan = ('[{"site": "step", "action": "hang", "at": 8, "seconds": 7.0, '
+            '"process": 0}]')
+    argv = _tiny_train_argv(2000, tmp_path / "ckpt") + [
+        "--fault_plan", plan, "--hang_timeout_s", "3.0",
+        "--hang_action", "checkpoint_exit", "--control_sync_steps", "2"]
+    procs, logs = _spawn_two(argv, port, tmp_path)
+    try:
+        for p in procs:
+            p.wait(timeout=540)
+    finally:
+        _kill_all(procs)
+
+    out0, out1 = (lg.read_text() for lg in logs)
+    assert procs[0].returncode == EXIT_HANG == 42, out0[-3000:]
+    assert procs[1].returncode == EXIT_HANG == 42, out1[-3000:]
+    # rank 0 (the hung host) announces the agreed escalation verdict
+    assert "agreed signals: escalate" in out0, out0[-3000:]
+    assert "saving emergency checkpoint" in out0, out0[-3000:]
+    # the jointly committed checkpoint carries ONE agreed step + topology
+    from vitax.checkpoint.orbax_io import latest_epoch, load_resume_meta
+    assert latest_epoch(str(tmp_path / "ckpt")) == 1
+    meta = load_resume_meta(str(tmp_path / "ckpt"), 1)
+    assert meta is not None and meta["step_in_epoch"] >= 8
+    assert meta["process_count"] == 2
+
+
+@pytest.mark.slow
+def test_two_process_peer_death_bounded_survivor_exit(tmp_path):
+    """SIGKILL one host mid-run (fault action `peer_loss` on process 1): the
+    survivor must NOT block forever in the agreement collective — the
+    peer-liveness monitor declares the peer lost after the grace window and
+    the survivor exits EXIT_HANG within the liveness deadline, well before
+    the coordination service's own (much longer) failure detection."""
+    port = _free_port()
+    plan = '[{"site": "step", "action": "peer_loss", "at": 6, "process": 1}]'
+    argv = _tiny_train_argv(2000, tmp_path / "ckpt") + [
+        "--fault_plan", plan, "--peer_heartbeat_s", "0.5",
+        "--peer_grace_s", "5.0"]
+    procs, logs = _spawn_two(argv, port, tmp_path)
+    try:
+        # rank 1 kills itself abruptly: SIGKILL, no drains
+        procs[1].wait(timeout=540)
+        assert procs[1].returncode == -signal.SIGKILL, \
+            logs[1].read_text()[-3000:]
+        # the survivor's exit is BOUNDED: grace (5s) + deadline timer (5s)
+        # + slack, nowhere near a wedged-collective forever
+        procs[0].wait(timeout=120)
+    finally:
+        _kill_all(procs)
+
+    out0 = logs[0].read_text()
+    assert procs[0].returncode == EXIT_HANG == 42, out0[-3000:]
+    assert "peer 1 lost" in out0, out0[-3000:]
+
+
+@pytest.mark.slow
+def test_elastic_two_to_one_supervised_resume(tmp_path):
+    """The N->M drill: a 2-process run is preempted mid-epoch (committed
+    sidecar records process_count=2), then a 1-process run under
+    tools/supervise.py resumes the SAME checkpoint — the supervisor announces
+    the topology change, the loop's elastic plan keeps the step-granular
+    resume exact (rank-interleaved sampling, no stream cursor), and training
+    completes without cursor or shape errors."""
+    port = _free_port()
+    ckpt = tmp_path / "ckpt"
+    plan = '[{"site": "step", "action": "sigterm", "at": 12, "process": 0}]'
+    argv = _tiny_train_argv(2000, ckpt) + ["--fault_plan", plan]
+    procs, logs = _spawn_two(argv, port, tmp_path)
+    try:
+        for p in procs:
+            p.wait(timeout=540)
+    finally:
+        _kill_all(procs)
+    out0 = logs[0].read_text()
+    assert procs[0].returncode == 0, out0[-3000:]
+    assert procs[1].returncode == 0, logs[1].read_text()[-3000:]
+    assert "SIGTERM received: saving preemption checkpoint" in out0
+    from vitax.checkpoint.orbax_io import load_resume_meta
+    meta = load_resume_meta(str(ckpt), 1)
+    assert meta is not None and meta["process_count"] == 2
+    resume_step = meta["step_in_epoch"]
+    assert resume_step >= 12
+
+    # resume on ONE process (8 local devices), supervised, a few more steps
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("JAX_NUM_PROCESSES", None)
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env.pop("JAX_PROCESS_ID", None)
+    metrics_dir = tmp_path / "metrics"
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "supervise.py"),
+         "--expect_processes", "1", "--",
+         *_tiny_train_argv(2000, ckpt), "--max_steps", "3",
+         "--metrics_dir", str(metrics_dir)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+    # the supervisor said what was about to happen...
+    assert "TOPOLOGY CHANGE" in r.stderr, r.stderr[-3000:]
+    # ...and the loop's elastic plan kept the resume step-exact
+    assert ("elastic resume: checkpoint epoch 1 was written by 2 "
+            "process(es), this run has 1") in r.stdout, r.stdout[-3000:]
+    assert (f"re-entering epoch 1 at step {resume_step + 1}"
+            in r.stdout), r.stdout[-3000:]
+    assert "training completed" in r.stdout
+    # the control event landed in the metrics stream for the report to count
+    mr = subprocess.run(
+        [sys.executable, os.path.join("tools", "metrics_report.py"),
+         str(metrics_dir / "metrics.jsonl"), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    summary = json.loads(mr.stdout)
+    # one observation (supervisor) + one action (the loop's elastic plan)
+    assert summary["control_events"]["topology_changes"] == 1
+    assert summary["control_events"]["elastic_resumes"] == 1

@@ -98,7 +98,7 @@ def test_two_process_training(tmp_path):
 def test_two_process_preemption_agreement(tmp_path):
     """SIGTERM delivered to ONLY rank 1 must stop BOTH processes at an agreed
     step with a committed preemption checkpoint — the collective flag sync in
-    vitax/train/loop.py (_preempt_agreed). Without agreement, rank 1 entering
+    vitax/train/control.py (ControlPlane.poll). Without agreement, rank 1 entering
     the save while rank 0 keeps stepping would deadlock the pod."""
     import signal
     import time

@@ -98,6 +98,23 @@ def summarize(path: str) -> dict:
         "replica_restarts": sum(1 for e in events
                                 if e.get("kind") == "replica_restart"),
     }
+    # control plane (vitax/train/control.py + the supervisor's elastic
+    # restarts): kind:"control" records, bucketed by their `event` field
+    control = [e for e in events if e.get("kind") == "control"]
+    summary["control_events"] = {
+        "agreed_preemptions": sum(1 for e in control
+                                  if e.get("event") == "agreed_preempt"),
+        "agreed_escalations": sum(1 for e in control
+                                  if e.get("event") == "agreed_escalation"),
+        "peer_loss_detections": sum(1 for e in control
+                                    if e.get("event") == "peer_loss"),
+        "topology_changes": sum(1 for e in control
+                                if e.get("event") == "topology_change"),
+        "elastic_resumes": sum(1 for e in control
+                               if e.get("event") == "elastic_resume"),
+    }
+    summary["hang_hard_exits"] = sum(1 for e in events
+                                     if e.get("kind") == "hang_hard_exit")
     # supervisor restarts (vitax/supervise.py appends these between child
     # runs, so they interleave with the child's own records)
     restarts = [e for e in events if e.get("kind") == "restart"]
@@ -161,6 +178,16 @@ def print_human(summary: dict) -> None:
               f"{summary['hang_escalations']}")
     if summary.get("fault_events"):
         print(f"  injected faults fired: {summary['fault_events']}")
+    ce = summary.get("control_events") or {}
+    if any(ce.values()):
+        print(f"  !! control plane: {ce['agreed_preemptions']} agreed "
+              f"preemption(s), {ce['agreed_escalations']} agreed "
+              f"escalation(s), {ce['peer_loss_detections']} peer loss(es), "
+              f"{ce['topology_changes']} topology change(s), "
+              f"{ce['elastic_resumes']} elastic resume(s)")
+    if summary.get("hang_hard_exits"):
+        print(f"  !! watchdog hard-deadline exits: "
+              f"{summary['hang_hard_exits']}")
     if summary.get("restart_count"):
         print(f"  !! supervisor restarts: {summary['restart_count']} "
               f"(last child exit code {summary['last_exit_code']})")

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Launcher shim: `python tools/supervise.py [flags] -- python
 run_vit_training.py ...` — see vitax/supervise.py for the restart loop,
-exit-code contract, and flags."""
+exit-code contract, elastic (topology-change) restart detection
+(--expect_processes), and flags."""
 
 import os
 import sys

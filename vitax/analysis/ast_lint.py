@@ -28,6 +28,13 @@ Finding codes (Error Prone style: stable ids, CI-greppable):
                  exception becomes an undiagnosable hang or wrong result
                  (narrow excepts like OSError are fine; so is a broad
                  except that logs, re-raises, or otherwise acts)
+  VTX107  ERROR  direct `preempt.requested()` / `.escalation_requested()`
+                 poll outside the control plane — a host acting on its LOCAL
+                 failure flag desynchronizes the pod (one host saves while
+                 the others keep stepping -> interleaved collectives ->
+                 deadlock); read the AGREED word via vitax/train/control.py
+                 ControlPlane.poll instead. The control plane's own two
+                 polls are the sanctioned (suppressed) call sites.
 
 Suppression: append `# vtx: ignore[VTX101] <reason>` to the offending line.
 Multiple codes: `# vtx: ignore[VTX101,VTX103] <reason>`. A suppression
@@ -218,6 +225,15 @@ class _Visitor(ast.NodeVisitor):
                 events.append((node.lineno, "fence"))
             elif _DISPATCH_NAME_RE.search(short or ""):
                 events.append((node.lineno, "dispatch"))
+
+        if (name in ("preempt.requested", "vitax.train.preempt.requested")
+                or (short == "escalation_requested"
+                    and isinstance(node.func, ast.Attribute))):
+            self._add("VTX107", "ERROR", node,
+                      f"direct `{name or short}()` failure-signal poll — a "
+                      "host acting on its local flag desynchronizes the pod; "
+                      "read the agreed word via vitax/train/control.py "
+                      "ControlPlane.poll instead")
 
         if short in ("devices", "local_devices") and name.startswith("jax.") \
                 and not node.args and not node.keywords:

@@ -121,6 +121,26 @@ def load_resume_step(ckpt_dir: str, epoch: int) -> Optional[int]:
         return None  # unreadable sidecar degrades to epoch-granular resume
 
 
+def load_resume_meta(ckpt_dir: str, epoch: int) -> Optional[dict]:
+    """The WHOLE mid-epoch resume sidecar payload for `epoch` —
+    {"step_in_epoch": int, "process_count": int, "stream_cursor": {...}?} —
+    or None (boundary save, missing, or unreadable). The elastic-resume
+    planner (vitax/train/control.py elastic_resume_plan) reads this to
+    detect a topology change between the run that wrote the checkpoint and
+    the run resuming it; older sidecars without `process_count` degrade to
+    "topology unknown" (no rounding), exactly like the other tolerant
+    readers here."""
+    path = _resume_meta_path(ckpt_dir, epoch)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        return payload if isinstance(payload, dict) else None
+    except (json.JSONDecodeError, OSError):
+        return None  # unreadable sidecar degrades to epoch-granular resume
+
+
 def load_stream_cursor(ckpt_dir: str, epoch: int) -> Optional[dict]:
     """The streaming-data-plane resume cursor `(epoch, shard_cursor,
     record_offset, shard, ...)` recorded with a MID-epoch save of `epoch`,
@@ -237,7 +257,11 @@ def save_state(ckpt_dir: str, epoch: int, state: PyTree,
     if jax.process_index() == 0:
         meta = _resume_meta_path(ckpt_dir, epoch)
         if step_in_epoch:
-            payload = {"step_in_epoch": int(step_in_epoch)}
+            # process_count records the topology that wrote this mid-epoch
+            # state: a resume under a DIFFERENT layout must know (elastic
+            # resume re-derives or epoch-rounds; vitax/train/control.py)
+            payload = {"step_in_epoch": int(step_in_epoch),
+                       "process_count": jax.process_count()}
             if stream_cursor is not None:
                 payload["stream_cursor"] = stream_cursor
             tmp = meta + f".tmp{os.getpid()}"

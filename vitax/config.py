@@ -155,6 +155,19 @@ class Config:
     #   loader-stall/SIGTERM drills at a chosen step or call site. "" (and
     #   no env var) = every hook is a zero-cost no-op; the compiled step
     #   program is identical either way (all hooks are host-side)
+    control_sync_steps: int = 10        # multi-host control-word agreement cadence, in steps
+    #   (vitax/train/control.py): SIGTERM/escalation/fault signals agreed
+    #   across hosts every N steps (plus every epoch boundary) via one tiny
+    #   collective. Hosts must use the same value. Single-host: signals are
+    #   checked every step for free and this cadence is moot
+    peer_heartbeat_s: float = 0.0       # >0: multi-host peer-liveness heartbeats through the
+    #   coordination-service KV store every N seconds; a peer whose beat
+    #   stops for peer_grace_s is declared dead and the survivors escalate
+    #   to checkpoint_exit (exit 42) instead of blocking in ICI collectives
+    #   forever. 0 = liveness off (single-host runs don't need it)
+    peer_grace_s: float = 0.0           # silence window before a peer is declared lost, and the
+    #   deadline for the survivor's own exit after the verdict; 0 = default
+    #   (10 x peer_heartbeat_s)
     compile_cache_dir: str = ""         # persistent XLA compile cache (restarts skip recompiles)
     debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
     log_memory: bool = True             # include HBM stats in step log
@@ -350,6 +363,19 @@ class Config:
                 faults.parse_plan(self.fault_plan)
             except ValueError as e:
                 raise AssertionError(f"--fault_plan invalid: {e}") from e
+        assert self.control_sync_steps >= 1, (
+            f"--control_sync_steps must be >= 1 (it is a collective cadence "
+            f"every host shares), got {self.control_sync_steps}")
+        assert self.peer_heartbeat_s >= 0, (
+            f"--peer_heartbeat_s must be >= 0 (0 = liveness off), "
+            f"got {self.peer_heartbeat_s}")
+        assert self.peer_grace_s >= 0, (
+            f"--peer_grace_s must be >= 0 (0 = 10 x peer_heartbeat_s), "
+            f"got {self.peer_grace_s}")
+        assert not (self.peer_grace_s > 0 and self.peer_heartbeat_s == 0), (
+            "--peer_grace_s without --peer_heartbeat_s does nothing: the "
+            "grace window bounds heartbeat silence, and no heartbeats are "
+            "being sent")
         if self.tensorboard:
             assert self.metrics_dir, (
                 "--tensorboard needs --metrics_dir: the TB event files live "
@@ -544,6 +570,22 @@ def build_parser() -> argparse.ArgumentParser:
                           "= emergency-save a committed checkpoint at the "
                           "next step boundary and exit 42 for a supervisor "
                           "(tools/supervise.py) to restart")
+    ext.add_argument("--control_sync_steps", type=int, default=10,
+                     help="multi-host failure-signal agreement cadence in "
+                          "steps (vitax/train/control.py; one tiny "
+                          "collective per cadence, plus every epoch "
+                          "boundary) — hosts must share the same value")
+    ext.add_argument("--peer_heartbeat_s", type=float, default=0.0,
+                     help=">0: heartbeat peers through the coordination "
+                          "service every N seconds; a peer silent for "
+                          "--peer_grace_s is declared dead and survivors "
+                          "escalate to checkpoint_exit (exit 42) instead "
+                          "of blocking in collectives (0 = off)")
+    ext.add_argument("--peer_grace_s", type=float, default=0.0,
+                     help="heartbeat-silence window before a peer is "
+                          "declared lost, and the survivor's own exit "
+                          "deadline after the verdict (0 = 10 x "
+                          "--peer_heartbeat_s)")
     ext.add_argument("--fault_plan", type=str, default="",
                      help="JSON fault-injection plan (vitax/faults.py), e.g. "
                           "'{\"site\": \"step\", \"at\": 6, \"action\": "

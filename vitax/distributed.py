@@ -56,6 +56,21 @@ def maybe_initialize() -> None:
                 "bring-up (otherwise every process would silently train "
                 "standalone on the full dataset)")
     if coord:
+        if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+            # CPU multi-process runs (clusters, the 2-process test harness)
+            # need a host collectives transport: jax's default ("none")
+            # fails every cross-process computation on the CPU backend with
+            # "Multiprocess computations aren't implemented". Gloo ships in
+            # jaxlib; TPU pods never reach this branch (ICI/DCN transports).
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception as e:  # noqa: BLE001 — a jaxlib without gloo
+                import warnings
+                warnings.warn(
+                    f"could not select the gloo CPU collectives transport "
+                    f"({type(e).__name__}: {e}); cross-process CPU "
+                    f"collectives will likely fail")
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=int(nproc),
                                    process_id=int(pid))
@@ -99,3 +114,18 @@ def any_across_processes(value: bool) -> bool:
     import numpy as np
     return bool(np.max(multihost_utils.process_allgather(
         np.int32(bool(value)))))
+
+
+def or_across_processes(value: int) -> int:
+    """Bitwise OR of a small non-negative host int over all processes — the
+    control plane's word-agreement fold (vitax/train/control.py): every
+    host's raised bits survive into the one agreed word every host sees
+    (a max fold would drop bits: max(PREEMPT, ESCALATE) keeps only one).
+    Same collective cost (one tiny allgather) and same call-discipline as
+    any_across_processes, which it generalizes. Free single-host."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+    import numpy as np
+    return int(np.bitwise_or.reduce(multihost_utils.process_allgather(
+        np.int64(int(value)))))

@@ -21,6 +21,10 @@ Sites (each a single host-side hook point; see the wiring modules):
   stream_read once per shard-file open attempt in the streaming reader
               (vitax/data/stream/format.py) — `oserror` exercises the
               open-retry-then-LoaderWorkerError path, `stall` a slow store
+  barrier_timeout
+              once per control-word agreement collective (vitax/train/
+              control.py ControlPlane.poll) — a `hang` here starves the
+              agreement exactly like a peer that died between cadences
 
 Actions:
   crash    os._exit(exit_code) — a hard kill: no atexit, no drains, exactly
@@ -30,6 +34,19 @@ Actions:
   oserror  raise OSError at the hook — a transient write/read failure
   stall    alias of hang for the loader site (a starved consumer)
   sigterm  os.kill(os.getpid(), SIGTERM) — a self-delivered preemption notice
+  peer_loss
+           os.kill(os.getpid(), SIGKILL) — an ABRUPT death (no handlers, no
+           flushes beyond the injection log): in the multiprocess harness
+           the surviving hosts see exactly what a real peer death leaves
+           behind — a heartbeat that stops (vitax/train/control.py
+           PeerLiveness drills)
+
+Multi-process drills: a spec may carry ``"process": K`` to fire on exactly
+one designated process (``peer_loss`` killing host K while host J survives);
+the default -1 fires on every process, preserving single-host plans
+unchanged. The process index comes from JAX_PROCESS_ID when set (the
+multiprocess harness exports it) so producer threads never have to touch
+the JAX runtime to decide.
 
 Every spec is deterministic: it fires when the site's call index (the
 explicit ``index=`` the hook passes, else an internal per-site counter)
@@ -50,8 +67,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-SITES = ("step", "ckpt_write", "loader", "stream_read")
-ACTIONS = ("crash", "hang", "oserror", "stall", "sigterm")
+SITES = ("step", "ckpt_write", "loader", "stream_read", "barrier_timeout")
+ACTIONS = ("crash", "hang", "oserror", "stall", "sigterm", "peer_loss")
 
 DEFAULT_CRASH_EXIT_CODE = 13
 DEFAULT_HANG_SECONDS = 3600.0
@@ -69,6 +86,7 @@ class FaultSpec:
     times: int = 1
     exit_code: int = DEFAULT_CRASH_EXIT_CODE
     seconds: float = DEFAULT_HANG_SECONDS
+    process: int = -1  # fire only on this process index; -1 = every process
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -84,6 +102,10 @@ class FaultSpec:
             raise ValueError(f"fault_plan: `times` must be >= 1, got {self.times}")
         if self.seconds < 0:
             raise ValueError(f"fault_plan: `seconds` must be >= 0, got {self.seconds}")
+        if self.process < -1:
+            raise ValueError(f"fault_plan: `process` must be a process "
+                             f"index >= 0, or -1 for all processes, got "
+                             f"{self.process}")
 
     @staticmethod
     def from_dict(d: dict) -> "FaultSpec":
@@ -102,7 +124,9 @@ class FaultSpec:
                "stall": f"seconds={self.seconds:g}"}.get(self.action, "")
         window = (f"at={self.at}" if self.times == 1
                   else f"at={self.at}..{self.at + self.times - 1}")
-        return f"{self.site}:{self.action}({window}{', ' + arg if arg else ''})"
+        who = f"@p{self.process}" if self.process >= 0 else ""
+        return (f"{self.site}:{self.action}{who}"
+                f"({window}{', ' + arg if arg else ''})")
 
 
 class FaultPlan:
@@ -126,7 +150,24 @@ class FaultPlan:
             idx = self._counters[site] if index is None else index
         for spec in self.specs:
             if spec.site == site and spec.at <= idx < spec.at + spec.times:
+                if spec.process >= 0 and spec.process != _process_index():
+                    continue
                 _act(spec, idx)
+
+
+def _process_index() -> int:
+    """This host's process index for `process`-designated specs. The
+    explicit-bring-up env var (the multiprocess harness exports it) wins so
+    hook sites on producer threads never initialize the JAX runtime as a
+    side effect; single-host runs with neither are process 0."""
+    env = os.environ.get("JAX_PROCESS_ID", "")
+    if env.isdigit():
+        return int(env)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 — no runtime == single process, not an error
+        return 0
 
 
 def _act(spec: FaultSpec, index: int) -> None:
@@ -151,6 +192,13 @@ def _act(spec: FaultSpec, index: int) -> None:
         raise OSError(f"injected fault: {spec.describe()} (call {index})")
     elif spec.action == "sigterm":
         os.kill(os.getpid(), signal.SIGTERM)
+    elif spec.action == "peer_loss":
+        # SIGKILL self: no handlers, no atexit, no final collectives — the
+        # surviving processes observe only a heartbeat that stops, which is
+        # the exact signature PeerLiveness (vitax/train/control.py) detects
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 # --- module-level registry: the hooks the subsystems call -------------------

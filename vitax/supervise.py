@@ -21,7 +21,18 @@ checkpoint, vitax/checkpoint/orbax_io.py), then:
   ``term_grace_s``;
 - appends ``kind:"restart"`` schema-1 events to ``<metrics_dir>/
   metrics.jsonl`` — the same stream the child's Recorder writes — so
-  tools/metrics_report.py surfaces restart count and last exit code.
+  tools/metrics_report.py surfaces restart count and last exit code;
+- detects ELASTIC (topology-change) restarts: when the checkpoint frontier's
+  sidecar records a different process count than the one the next child
+  launch runs under (``--expect_processes``, default: the JAX_NUM_PROCESSES
+  bring-up env var, else 1), the supervisor announces it loudly and appends
+  a ``kind:"control"`` ``topology_change`` event — the child's own
+  elastic-resume path (vitax/train/control.py) re-derives steps_per_epoch
+  and remaps or epoch-rounds the stream cursor, so an N-host checkpoint
+  restarts on M hosts without operator surgery. Exit 42 now also covers the
+  COORDINATED multi-host escalations (agreed hang/fault/peer-loss verdicts):
+  every host exits with the same code at the same committed step, so one
+  supervisor decision fits all hosts.
 
 Exit-code contract:
   0           child completed (or drained cleanly after a forwarded SIGTERM)
@@ -131,6 +142,31 @@ def checkpoint_progress(ckpt_dir: str) -> Tuple[int, int]:
     return (latest, load_resume_step(ckpt_dir, latest) or 0)
 
 
+def checkpoint_topology(ckpt_dir: str) -> Optional[int]:
+    """The process count that wrote the frontier checkpoint's mid-epoch
+    sidecar, or None (boundary save, pre-PR-10 sidecar, no checkpoint).
+    The elastic-restart path compares this against the topology the child
+    is about to launch with (vitax/train/control.py elastic_resume_plan
+    makes the in-loop decision; the supervisor's job is only to SAY what
+    is about to happen and record it)."""
+    from vitax.checkpoint.orbax_io import committed_epochs, load_resume_meta
+    epochs = committed_epochs(ckpt_dir)
+    if not epochs:
+        return None
+    meta = load_resume_meta(ckpt_dir, epochs[-1]) or {}
+    count = meta.get("process_count")
+    return int(count) if isinstance(count, int) and count >= 1 else None
+
+
+def expected_process_count() -> int:
+    """The topology the next child launch will run under: the explicit
+    bring-up env var (the same one vitax/distributed.py reads), else 1.
+    The supervisor launches the child with its own inherited environment,
+    so this is exactly what jax.process_count() will say in the child."""
+    nproc = os.environ.get("JAX_NUM_PROCESSES", "")
+    return int(nproc) if nproc.isdigit() and int(nproc) >= 1 else 1
+
+
 class Supervisor:
     """Restart loop around one training subprocess.
 
@@ -148,7 +184,9 @@ class Supervisor:
                  spawn: Optional[Callable] = None,
                  progress_fn: Optional[Callable[[], Tuple]] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 poll_interval_s: float = 0.1):
+                 poll_interval_s: float = 0.1,
+                 expect_processes: int = 0,
+                 topology_fn: Optional[Callable[[], Optional[int]]] = None):
         assert max_restarts >= 0, max_restarts
         assert crash_loop_tolerance >= 0, crash_loop_tolerance
         assert backoff_s >= 0 and backoff_max_s >= 0
@@ -165,6 +203,14 @@ class Supervisor:
         self._progress = progress_fn or (
             lambda: checkpoint_progress(self.ckpt_dir))
         self._sleep = sleep
+        # elastic restarts: 0 = topology checking off; > 0 = the process
+        # count the next child launch runs under, compared against the
+        # frontier sidecar's recorded topology before each spawn
+        self.expect_processes = expect_processes
+        self._topology = topology_fn or (
+            lambda: checkpoint_topology(self.ckpt_dir))
+        self.topology_changes = 0
+        self._topology_noted: Optional[int] = None
         self.restart_count = 0
         self.last_exit_code: Optional[int] = None
         self._term_requested = False
@@ -182,18 +228,13 @@ class Supervisor:
             pass  # not the main thread (tests): forwarding unavailable
 
     # -- telemetry -----------------------------------------------------------
-    def _event(self, **payload) -> None:
+    def _append_event(self, kind: str, **payload) -> None:
         """Append one schema-1 event to the run's metrics.jsonl (the child is
         not running while the supervisor writes, so the append interleaves
         with the Recorder's stream only at line granularity — which JSONL is
         built for). Fail-soft: supervision must not die over observability."""
         record = {"schema": SCHEMA_VERSION, "time": time.time(),
-                  "kind": "restart", "rank": 0, **payload}
-        self._log(f"restart {payload.get('restart')}: child exit "
-                  f"{payload.get('exit_code')}, "
-                  f"{'progress' if payload.get('progress') else 'NO progress'}"
-                  f" since last start, backing off "
-                  f"{payload.get('backoff_s'):.2f}s")
+                  "kind": kind, "rank": 0, **payload}
         if not self.metrics_dir:
             return
         try:
@@ -202,7 +243,40 @@ class Supervisor:
             with open(path, "a", encoding="utf-8") as f:
                 f.write(json.dumps(record, sort_keys=True) + "\n")
         except OSError as e:
-            self._log(f"cannot write restart event ({e}); continuing")
+            self._log(f"cannot write {kind} event ({e}); continuing")
+
+    def _event(self, **payload) -> None:
+        self._log(f"restart {payload.get('restart')}: child exit "
+                  f"{payload.get('exit_code')}, "
+                  f"{'progress' if payload.get('progress') else 'NO progress'}"
+                  f" since last start, backing off "
+                  f"{payload.get('backoff_s'):.2f}s")
+        self._append_event("restart", **payload)
+
+    def _check_topology(self) -> None:
+        """Before each child launch: compare the frontier checkpoint's
+        recorded topology against the one this launch runs under, and say
+        LOUDLY (log + kind:"control" event) when they differ — the child's
+        elastic-resume path (vitax/train/loop.py _elastic_resume) re-derives
+        steps_per_epoch and remaps or epoch-rounds the stream cursor, so the
+        restart proceeds instead of failing on cursor/shape checks."""
+        if not self.expect_processes:
+            return
+        recorded = self._topology()
+        if recorded is None or recorded == self.expect_processes:
+            return
+        if recorded == self._topology_noted:
+            return  # already announced this same mismatch
+        self._topology_noted = recorded
+        self.topology_changes += 1
+        self._log(f"TOPOLOGY CHANGE: checkpoint frontier was written by "
+                  f"{recorded} process(es); child launching with "
+                  f"{self.expect_processes} — elastic resume will re-derive "
+                  f"steps_per_epoch and remap or epoch-round the stream "
+                  f"cursor")
+        self._append_event("control", event="topology_change",
+                           from_processes=recorded,
+                           to_processes=self.expect_processes)
 
     @staticmethod
     def _log(msg: str) -> None:
@@ -241,6 +315,7 @@ class Supervisor:
         self._log(f"supervising: {' '.join(map(str, self.child_argv))}")
         while True:
             before = self._progress()
+            self._check_topology()
             child = self._spawn(self.child_argv)
             rc = self._wait(child)
             self.last_exit_code = rc
@@ -306,6 +381,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--term_grace_s", type=float, default=DEFAULT_TERM_GRACE_S,
                    help="seconds a SIGTERM-forwarded child gets to drain "
                         "before a hard kill")
+    p.add_argument("--expect_processes", type=int, default=0,
+                   help="process count the child launches with, for elastic "
+                        "(topology-change) restart detection against the "
+                        "checkpoint frontier's recorded topology (default "
+                        "0 = read JAX_NUM_PROCESSES from the environment, "
+                        "else 1)")
     return p
 
 
@@ -330,7 +411,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_restarts=args.max_restarts, backoff_s=args.backoff_s,
         backoff_max_s=args.backoff_max_s,
         crash_loop_tolerance=args.crash_loop_tolerance,
-        term_grace_s=args.term_grace_s)
+        term_grace_s=args.term_grace_s,
+        expect_processes=args.expect_processes or expected_process_count())
     return sup.run()
 
 

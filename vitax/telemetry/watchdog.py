@@ -80,6 +80,7 @@ class Watchdog:
                  action: str = "dump",
                  hard_deadline_s: Optional[float] = None,
                  on_escalate: Optional[Callable[[dict], None]] = None,
+                 on_hard_exit: Optional[Callable[[dict], None]] = None,
                  hard_exit: Callable[[int], None] = os._exit):
         assert timeout_s > 0, timeout_s
         assert action in HANG_ACTIONS, action
@@ -90,6 +91,12 @@ class Watchdog:
         self.hard_deadline_s = (float(hard_deadline_s) if hard_deadline_s
                                 else 2.0 * self.timeout_s)
         self.on_escalate = on_escalate
+        # last-words hook, run right before the hard-deadline os._exit: the
+        # train loop wires it to a flushed kind:"hang_hard_exit" telemetry
+        # event + the control plane's fault publication, so peers learn the
+        # cause instead of just losing a heartbeat. Assignable after
+        # construction (the control plane is built later than the watchdog).
+        self.on_hard_exit = on_hard_exit
         self._hard_exit = hard_exit
         # poll often enough to notice promptly, rarely enough to cost nothing
         self.poll_s = poll_s if poll_s else min(max(timeout_s / 4.0, 0.05), 5.0)
@@ -128,6 +135,28 @@ class Watchdog:
         """Sticky: True once a stall under action="checkpoint_exit" dumped."""
         return self._escalated.is_set()
 
+    def request_escalation(self, reason: str = "external") -> None:
+        """Escalate from OUTSIDE the stall detector (the control plane's
+        peer-loss path, vitax/train/control.py): arm the hard deadline and
+        raise the sticky flag exactly like a hang-dump escalation, minus the
+        dump — the caller already knows the cause. Idempotent: a watchdog
+        that escalated on its own keeps its earlier deadline."""
+        if self._escalated.is_set():
+            return
+        # same ordering contract as _escalate: deadline armed BEFORE the flag
+        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
+        self._escalated.set()
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate({"reason": reason,
+                                  "timeout_s": self.timeout_s,
+                                  "exit_code": EXIT_HANG,
+                                  "hard_deadline_s": self.hard_deadline_s})
+            except Exception as e:  # noqa: BLE001
+                print(f"[vitax.watchdog rank {self.rank}] on_escalate sink "
+                      f"failed: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+
     def acknowledge_escalation(self) -> None:
         """The loop saw the flag and is taking the emergency checkpoint:
         push the hard-exit deadline out by another hard_deadline_s so the
@@ -154,6 +183,15 @@ class Watchdog:
               f"({self.hard_deadline_s:.1f}s) passed without the loop "
               f"reaching a step boundary — hard-exiting with code "
               f"{EXIT_HANG} for the supervisor", file=sys.stderr, flush=True)
+        if self.on_hard_exit is not None:
+            try:  # JSONL sinks flush per record: the event survives os._exit
+                self.on_hard_exit({"rank": self.rank,
+                                   "exit_code": EXIT_HANG,
+                                   "hard_deadline_s": self.hard_deadline_s})
+            except Exception as e:  # noqa: BLE001 — last words must not block the exit
+                print(f"[vitax.watchdog rank {self.rank}] on_hard_exit sink "
+                      f"failed: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
         self._hard_deadline_at = None  # a test's fake exit returns; disarm
         self._hard_exit(EXIT_HANG)
 

@@ -1,0 +1,542 @@
+"""Coordinated multi-host failure control plane (ROADMAP item 4).
+
+PR 7 made single-process failures survivable, but each host still decided to
+escalate, save, and exit ON ITS OWN — one host entering an emergency save
+while the others keep stepping interleaves mismatched collectives and wedges
+the pod, which is exactly the hang class the watchdog exists to cure. This
+module folds every host-local failure signal into ONE packed control word
+and agrees it across hosts at the train loop's existing sync points, so all
+hosts take the SAME action at the SAME step:
+
+  bit 0  PREEMPT    SIGTERM delivered (vitax/train/preempt.py)
+  bit 1  ESCALATE   watchdog hang escalation (vitax/telemetry/watchdog.py)
+  bit 2  FAULT      a host flagged a non-hang fault (e.g. the watchdog's
+                    hard-deadline exit publishing its cause on the way out)
+  bit 3  PEER_LOST  peer-liveness monitor declared a peer dead
+
+Agreement is the bitwise OR of the word over processes
+(distributed.or_across_processes) on the same cadence the preemption-only
+flag sync used — every `sync_steps` steps in-loop plus unconditionally at
+each epoch boundary — so multi-host agreement costs the same single tiny
+collective it did before this module existed. Single-host, poll() is a local
+flag read every step (free), preserving PR 7's exact semantics.
+
+The loop reacts to an agreed word at the step boundary:
+
+  preempt only      -> jointly committed preemption checkpoint, exit 0
+  escalate/fault/
+  peer_lost         -> jointly committed emergency checkpoint, exit 42
+                       (EXIT_HANG) on ALL hosts — one uniform code the
+                       supervisor (vitax/supervise.py) understands
+
+Note the subtlety on PEER_LOST: if the agreement collective itself completed,
+every process is demonstrably alive, so the joint save is safe. A REALLY dead
+peer never reaches agreement — that path is covered by PeerLiveness below,
+which bypasses agreement entirely and bounds the survivors' exit.
+
+Peer liveness: collectives over a dead peer block forever — the one hang the
+watchdog can dump but never recover from on a pod. PeerLiveness heartbeats
+through the JAX coordination service KV store (host TCP, no device
+collectives: it keeps working exactly when ICI does not). Each process bumps
+`vitax/hb/<pid>` every `interval_s`; a monitor thread declares a peer lost
+when its key stops advancing for `grace_s` and then escalates THIS host:
+raise the watchdog's sticky escalation flag (bounded by its hard deadline)
+plus an independent hard-exit timer, so the survivor exits EXIT_HANG within
+a deadline even while wedged inside a collective. The supervisor restarts
+from the last committed checkpoint.
+
+Elastic resume (topology change): restore is already pinned cross-topology
+(Orbax reshards on load; tests/test_checkpoint.py), and the index-sampled
+loaders (ImageFolder/fake, vitax/data/loader.py ShardedSampler) partition
+each epoch RANK-INTERLEAVED — the first k global batches are the same record
+set for any process count — so a mid-epoch step sidecar resumes exactly even
+when N hosts wrote it and M hosts read it. The streaming data plane is the
+exception: its shard->host assignment is disjoint per topology, so a cursor
+written under N processes is meaningless under M; elastic_resume_plan()
+rounds the resume DOWN to the epoch boundary (loudly) instead of letting
+check_cursor fail or, worse, silently feeding different records.
+
+Everything here is host-side and import-light (no jax at module scope): the
+compiled step program is bit-identical with the control plane on or off, and
+the pack/agree/plan logic unit-tests without a device runtime
+(tests/test_control.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from vitax import faults
+from vitax.telemetry.watchdog import EXIT_HANG
+
+# Control-word bit layout (documented in README "Multi-host semantics").
+# The agreement fold is bitwise OR, so every host's raised bits survive
+# into the one word all hosts see.
+BIT_PREEMPT = 1 << 0
+BIT_ESCALATE = 1 << 1
+BIT_FAULT = 1 << 2
+BIT_PEER_LOST = 1 << 3
+_ALL_BITS = BIT_PREEMPT | BIT_ESCALATE | BIT_FAULT | BIT_PEER_LOST
+
+# Default agreement cadence (steps). Bounds the extra exposure after a local
+# signal to min(sync_steps, rest of the epoch) steps of wall time — the epoch
+# boundary always syncs too. Hosts must use the SAME value (the word sync is
+# a collective); vitax/config.py --control_sync_steps carries it.
+DEFAULT_SYNC_STEPS = 10
+
+# Coordination-service KV namespaces (per-process keys).
+HEARTBEAT_KEY_PREFIX = "vitax/hb"
+FAULT_KEY_PREFIX = "vitax/fault"
+
+
+def pack_word(preempt: bool = False, escalate: bool = False,
+              fault: bool = False, peer_lost: bool = False) -> int:
+    """Fold the four host-local failure signals into one small int."""
+    return ((BIT_PREEMPT if preempt else 0)
+            | (BIT_ESCALATE if escalate else 0)
+            | (BIT_FAULT if fault else 0)
+            | (BIT_PEER_LOST if peer_lost else 0))
+
+
+@dataclasses.dataclass(frozen=True)
+class Signals:
+    """An unpacked control word — what the hosts agreed happened."""
+
+    preempt: bool = False
+    escalate: bool = False
+    fault: bool = False
+    peer_lost: bool = False
+
+    @property
+    def word(self) -> int:
+        return pack_word(self.preempt, self.escalate, self.fault,
+                         self.peer_lost)
+
+    @property
+    def any(self) -> bool:
+        return self.preempt or self.escalate or self.fault or self.peer_lost
+
+    @property
+    def emergency(self) -> bool:
+        """Agreed signals that demand the EXIT_HANG emergency path (vs the
+        clean preemption drain): escalation, fault, or a peer-loss verdict."""
+        return self.escalate or self.fault or self.peer_lost
+
+    def describe(self) -> str:
+        names = [n for n, on in (("preempt", self.preempt),
+                                 ("escalate", self.escalate),
+                                 ("fault", self.fault),
+                                 ("peer_lost", self.peer_lost)) if on]
+        return "+".join(names) or "none"
+
+
+def unpack_word(word: int) -> Signals:
+    """Inverse of pack_word. Unknown high bits are rejected: an agreement
+    that returns garbage (version-skewed peer, corrupted fold) must fail
+    loudly, not be quietly masked into 'no signal'."""
+    word = int(word)
+    if word < 0 or word & ~_ALL_BITS:
+        raise ValueError(f"control word {word:#x} has bits outside the "
+                         f"defined layout {_ALL_BITS:#x} — mixed vitax "
+                         f"versions across hosts?")
+    return Signals(preempt=bool(word & BIT_PREEMPT),
+                   escalate=bool(word & BIT_ESCALATE),
+                   fault=bool(word & BIT_FAULT),
+                   peer_lost=bool(word & BIT_PEER_LOST))
+
+
+def coordination_client():
+    """The JAX coordination-service KV client, or None when the distributed
+    runtime is not initialized (single-host runs, unit tests). Host-plane
+    TCP to the coordinator — alive exactly when ICI collectives may not be."""
+    try:
+        from jax._src import distributed as jax_distributed
+        return jax_distributed.global_state.client
+    except Exception:  # noqa: BLE001 — a private-API drift degrades to "no liveness", never a crash
+        return None
+
+
+class ControlPlane:
+    """Folds local failure flags into a word and agrees it across hosts.
+
+    The train loop calls poll(step_in_epoch) at every step boundary (and
+    with step_in_epoch=None at each epoch boundary). Single-process: the
+    local word is unpacked every call — identical to PR 7's per-step local
+    flag checks. Multi-process: off-cadence calls return Signals() without
+    any collective; on-cadence calls run ONE OR-fold of the packed word
+    (the `collective` injection point — tests agree words with a plain
+    python fold, no JAX).
+
+    `watchdog`, `on_event` (wired to Recorder kind:"control" events on rank
+    0) and `hard_exit` are injectable for the same reason. The plane also
+    owns the peer-liveness monitor (start_liveness) and the reaction to a
+    lost peer: escalate this host with a bounded hard-exit deadline.
+    """
+
+    def __init__(self, sync_steps: int = DEFAULT_SYNC_STEPS,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 watchdog=None,
+                 collective: Optional[Callable[[int], int]] = None,
+                 on_event: Optional[Callable[[dict], None]] = None,
+                 hard_exit: Optional[Callable[[int], None]] = None):
+        assert sync_steps >= 1, sync_steps
+        if process_index is None or process_count is None:
+            import jax
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        self.sync_steps = int(sync_steps)
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.watchdog = watchdog
+        self._collective = collective
+        self._on_event = on_event
+        self._hard_exit = hard_exit
+        self._fault = threading.Event()
+        self._peer_lost = threading.Event()
+        self._lost_peers: list = []
+        self._announced = False
+        self._liveness: Optional[PeerLiveness] = None
+        self._exit_timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+
+    # -- local word ----------------------------------------------------------
+    def local_word(self) -> int:
+        """THIS host's packed signals. The two polls below are the sanctioned
+        call sites the VTX107 lint rule guards: every other module must read
+        the agreed word through poll(), never the raw local flags."""
+        from vitax.train import preempt
+        word = 0
+        if preempt.requested():  # vtx: ignore[VTX107] the control plane is the sanctioned raw-flag poller
+            word |= BIT_PREEMPT
+        if (self.watchdog is not None
+                and self.watchdog.escalation_requested()):  # vtx: ignore[VTX107] sanctioned raw-flag poller
+            word |= BIT_ESCALATE
+        if self._fault.is_set():
+            word |= BIT_FAULT
+        if self._peer_lost.is_set():
+            word |= BIT_PEER_LOST
+        return word
+
+    def set_fault(self, reason: str = "") -> None:
+        """Raise this host's fault bit (sticky); folded into the next
+        agreement so ALL hosts exit through the coordinated path."""
+        self._fault.set()
+        self._emit("fault_flagged", reason=reason)
+
+    def publish_fault(self, reason: str) -> None:
+        """set_fault + best-effort publication of the cause under the
+        coordination-service key vitax/fault/<pid>, so peers that only see a
+        lost heartbeat can attribute it. Safe on the way out of a hard exit:
+        never raises, never blocks beyond the KV call itself."""
+        self._fault.set()
+        client = coordination_client()
+        if client is None:
+            return
+        try:
+            client.key_value_set(
+                f"{FAULT_KEY_PREFIX}/{self.process_index}", reason,
+                allow_overwrite=True)
+        except Exception as e:  # noqa: BLE001 — publishing the cause is best-effort by design
+            print(f"vitax.control: could not publish fault cause "
+                  f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+
+    # -- agreement -----------------------------------------------------------
+    def warmup(self) -> None:
+        """Run one throwaway fold of word 0 so the agreement collective's
+        XLA compile + transport setup happen OUTSIDE any hang-deadline
+        window. Without this the FIRST on-cadence poll pays seconds of
+        compile while the watchdog's hard deadline is already ticking — an
+        escalating host could be hard-exited mid-agreement. The train loop
+        calls this before the training-begins barrier; every process must
+        (it is a collective). No-op single-host."""
+        if self.process_count <= 1:
+            return
+        collective = self._collective
+        if collective is None:
+            from vitax import distributed
+            collective = distributed.or_across_processes
+        collective(0)
+
+    def poll(self, step_in_epoch: Optional[int],
+             epoch: int = 0) -> Signals:
+        """The step-boundary check. Returns the AGREED signals (all hosts see
+        the same value at the same call), or Signals() when nothing is
+        flagged / this step is off-cadence. Multi-host this is a collective
+        on-cadence: every process must call it at the same points."""
+        if self.process_count == 1:
+            sig = unpack_word(self.local_word())
+            if sig.any:
+                self._announce(sig, step_in_epoch, epoch)
+            return sig
+        on_cadence = (step_in_epoch is None
+                      or (step_in_epoch + 1) % self.sync_steps == 0)
+        if not on_cadence:
+            return Signals()
+        # drill point for the agreement path itself (site `barrier_timeout`:
+        # a hang injected here starves the collective exactly like a peer
+        # that died between cadences)
+        faults.fire("barrier_timeout")
+        collective = self._collective
+        if collective is None:
+            from vitax import distributed
+            collective = distributed.or_across_processes
+        sig = unpack_word(collective(self.local_word()))
+        if sig.any:
+            self._announce(sig, step_in_epoch, epoch)
+        return sig
+
+    def _announce(self, sig: Signals, step_in_epoch, epoch: int) -> None:
+        """One kind:"control" event per run for the first agreed word (the
+        loop acts on it immediately and terminally, but epoch-boundary and
+        single-host polls can observe the same word twice)."""
+        with self._lock:
+            if self._announced:
+                return
+            self._announced = True
+        self._emit("agreed_escalation" if sig.emergency else "agreed_preempt",
+                   word=sig.word, signals=sig.describe(), epoch=int(epoch),
+                   step_in_epoch=(None if step_in_epoch is None
+                                  else int(step_in_epoch) + 1))
+
+    def _emit(self, event: str, **payload) -> None:
+        if self._on_event is None:
+            return
+        try:  # JSONL sinks flush per record: events survive a hard exit
+            self._on_event({"event": event, **payload})
+        except Exception as e:  # noqa: BLE001 — observability must not mask the failure path
+            print(f"vitax.control: event sink failed ({type(e).__name__}: "
+                  f"{e})", file=sys.stderr, flush=True)
+
+    # -- peer liveness -------------------------------------------------------
+    def start_liveness(self, interval_s: float, grace_s: float,
+                       client=None) -> bool:
+        """Start heartbeating + monitoring peers. Returns False (with a
+        loud line) when no coordination service is reachable or the run is
+        single-process — liveness then simply stays off, never fatal."""
+        if self.process_count <= 1:
+            return False
+        client = client if client is not None else coordination_client()
+        if client is None:
+            print("vitax.control: peer liveness requested but no "
+                  "coordination service client is available; peer-death "
+                  "detection disabled for this run",
+                  file=sys.stderr, flush=True)
+            return False
+        self._liveness = PeerLiveness(
+            process_index=self.process_index,
+            process_count=self.process_count,
+            interval_s=interval_s, grace_s=grace_s, client=client,
+            on_loss=self._on_peer_loss)
+        self._liveness.start()
+        return True
+
+    def _on_peer_loss(self, peer: int, silent_s: float,
+                      cause: Optional[str]) -> None:
+        """A peer's heartbeat stopped. Collectives over it would block
+        forever, so escalate THIS host under a bounded deadline: raise the
+        watchdog's sticky escalation flag (its hard deadline covers a loop
+        wedged mid-collective) AND an independent exit timer (covers runs
+        whose watchdog is off or not yet armed). If the loop is healthy it
+        reaches the next boundary first and exits through the coordinated
+        path; either way the survivor is gone within the deadline instead
+        of hanging in ICI forever."""
+        self._lost_peers.append(peer)
+        self._peer_lost.set()
+        why = f" (peer published cause: {cause})" if cause else ""
+        print(f"vitax.control: peer {peer} lost — no heartbeat for "
+              f"{silent_s:.1f}s{why}; escalating to checkpoint_exit "
+              f"(exit {EXIT_HANG} within the liveness deadline)",
+              file=sys.stderr, flush=True)
+        self._emit("peer_loss", peer=int(peer), silent_s=round(silent_s, 3),
+                   cause=cause, exit_code=EXIT_HANG)
+        deadline_s = (self._liveness.grace_s if self._liveness is not None
+                      else 30.0)
+        if self.watchdog is not None:
+            self.watchdog.request_escalation(
+                f"peer {peer} lost (heartbeat silent {silent_s:.1f}s)")
+        with self._lock:
+            if self._exit_timer is None:
+                self._exit_timer = threading.Timer(
+                    deadline_s, self._deadline_exit, args=(peer,))
+                self._exit_timer.daemon = True
+                self._exit_timer.start()
+
+    def _deadline_exit(self, peer: int) -> None:
+        print(f"vitax.control: loop did not reach a step boundary within "
+              f"the liveness deadline after losing peer {peer} — "
+              f"hard-exiting {EXIT_HANG} for the supervisor",
+              file=sys.stderr, flush=True)
+        hard_exit = self._hard_exit
+        if hard_exit is None:
+            import os
+            hard_exit = os._exit
+        hard_exit(EXIT_HANG)
+
+    def peer_loss_suspected(self, wait: bool = True) -> Optional[int]:
+        """Classify a runtime error that escaped a collective region: is a
+        dead peer the likely cause? A peer death shows up two ways — ICI
+        collectives BLOCK on it (the timer path above), host-plane transports
+        like Gloo surface it as a runtime ERROR instead. The loop calls this
+        from its error path: returns the lost peer's index once the liveness
+        monitor reaches its verdict (waiting up to grace + one beat interval
+        when `wait`), or None — no liveness running, or every peer still
+        beating, i.e. the error is a genuine bug the caller must re-raise."""
+        liveness = self._liveness
+        if liveness is None:
+            return None
+        # worst case the peer died a whole grace window before the error
+        # surfaced here, so the verdict lands within grace + one monitor
+        # poll of NOW; the extra second absorbs scheduler jitter
+        deadline = (time.monotonic() + liveness.grace_s
+                    + liveness.interval_s + 1.0)
+        while wait and time.monotonic() < deadline:
+            if self._peer_lost.is_set():
+                break
+            time.sleep(min(liveness.interval_s, 0.2))
+        if not self._peer_lost.is_set():
+            return None
+        return self._lost_peers[0] if self._lost_peers else None
+
+    def stop(self) -> None:
+        if self._liveness is not None:
+            self._liveness.stop()
+            self._liveness = None
+        with self._lock:
+            if self._exit_timer is not None:
+                self._exit_timer.cancel()
+                self._exit_timer = None
+
+
+class PeerLiveness:
+    """KV heartbeats: every process bumps its key; a monitor thread flags
+    peers whose key stops advancing for `grace_s`.
+
+    All calls are bounded (`blocking_key_value_get` carries a timeout), so
+    the monitor keeps turning even when the coordinator is slow; KV errors
+    count as "no advance" rather than crashing — a survivor mid-outage must
+    converge to the peer-loss verdict, not die on a TCP hiccup. `on_loss`
+    fires at most once per peer, from the monitor thread (it must not touch
+    device state — same rule as the watchdog thread). `client` and `clock`
+    are injectable: tests drive loss verdicts with a fake KV store and no
+    real sleeps beyond the poll interval."""
+
+    def __init__(self, process_index: int, process_count: int,
+                 interval_s: float, grace_s: float, client,
+                 on_loss: Callable[[int, float, Optional[str]], None],
+                 clock: Callable[[], float] = time.monotonic):
+        assert interval_s > 0, interval_s
+        assert grace_s > 0, grace_s
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.interval_s = float(interval_s)
+        self.grace_s = float(grace_s)
+        self.client = client
+        self.on_loss = on_loss
+        self.clock = clock
+        self.peers = [p for p in range(self.process_count)
+                      if p != self.process_index]
+        self.lost: set = set()
+        self._stop = threading.Event()
+        self._threads: list = []
+
+    def start(self) -> None:
+        for name, target in (("vitax-hb-beat", self._beat),
+                             ("vitax-hb-monitor", self._monitor)):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.interval_s + 1.0)
+
+    def _key(self, peer: int) -> str:
+        return f"{HEARTBEAT_KEY_PREFIX}/{peer}"
+
+    def _beat(self) -> None:
+        seq = 0
+        while True:
+            seq += 1
+            try:
+                self.client.key_value_set(self._key(self.process_index),
+                                          str(seq), allow_overwrite=True)
+            except Exception as e:  # noqa: BLE001 — a beat lost to a KV hiccup must not kill the beater
+                print(f"vitax.control: heartbeat write failed "
+                      f"({type(e).__name__}: {e}); retrying",
+                      file=sys.stderr, flush=True)
+            if self._stop.wait(self.interval_s):
+                return
+
+    def _monitor(self) -> None:
+        # a peer that NEVER writes (died during compile, before its first
+        # beat) still gets flagged: the grace clock starts at monitor start
+        last_seen: Dict[int, tuple] = {p: (None, self.clock())
+                                       for p in self.peers}
+        timeout_ms = max(int(min(self.interval_s, 2.0) * 1000), 50)
+        while not self._stop.wait(self.interval_s):
+            now = self.clock()
+            for peer in self.peers:
+                if peer in self.lost:
+                    continue
+                try:
+                    value = self.client.blocking_key_value_get(
+                        self._key(peer), timeout_ms)
+                except Exception:  # noqa: BLE001 — timeout/KV error == no advance; the grace window decides
+                    value = None
+                prev_value, since = last_seen[peer]
+                if value is not None and value != prev_value:
+                    last_seen[peer] = (value, now)
+                elif now - since >= self.grace_s:
+                    self.lost.add(peer)
+                    self.on_loss(peer, now - since, self._cause(peer))
+
+    def _cause(self, peer: int) -> Optional[str]:
+        """The cause the dying peer published (publish_fault), if any."""
+        try:
+            return self.client.blocking_key_value_get(
+                f"{FAULT_KEY_PREFIX}/{peer}", 200)
+        except Exception:  # noqa: BLE001 — no published cause is the common case, not an error
+            return None
+
+
+# -- elastic resume (topology change) ----------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ResumePlan:
+    """How to re-enter a checkpointed epoch under the CURRENT topology."""
+
+    resume_step: int           # steps already done; 0 = epoch-boundary entry
+    topology_changed: bool     # sidecar written under a different layout
+    epoch_rounded: bool        # stream cursor invalidated -> boundary resume
+    from_processes: int        # 0 when the sidecar predates this field
+    skipped_steps: int         # mid-epoch progress dropped by the rounding
+
+
+def elastic_resume_plan(meta: Optional[dict],
+                        process_count: int) -> ResumePlan:
+    """Decide the resume step for a (possibly) topology-changed restart.
+
+    `meta` is the mid-epoch resume sidecar payload (orbax_io.load_resume_meta)
+    or None for an epoch-boundary checkpoint. The index-sampled loaders
+    partition rank-interleaved, so their step-granular resume survives any
+    N->M change; a stream cursor's shard->host assignment does not — when
+    the sidecar carries one AND the topology drifted, round down to the
+    epoch boundary (re-running the partial epoch beats feeding a silently
+    different record stream, and beats check_cursor's hard failure). Pure
+    function: unit-tested without JAX."""
+    step = int(meta.get("step_in_epoch") or 0) if meta else 0
+    recorded = int(meta.get("process_count") or 0) if meta else 0
+    changed = bool(recorded) and recorded != int(process_count)
+    has_cursor = bool(meta) and isinstance(meta.get("stream_cursor"), dict)
+    rounded = changed and has_cursor and step > 0
+    return ResumePlan(resume_step=0 if rounded else step,
+                      topology_changed=changed,
+                      epoch_rounded=rounded,
+                      from_processes=recorded,
+                      skipped_steps=step if rounded else 0)

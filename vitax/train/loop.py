@@ -26,6 +26,7 @@ from vitax.config import Config
 from vitax.data import build_datasets
 from vitax.models import build_model, count_params
 from vitax.parallel.mesh import BATCH_AXES, build_mesh
+from vitax.train.control import ControlPlane
 from vitax.train.state import TrainState, build_optimizer, make_train_state
 from vitax.train.step import make_eval_step, make_train_step
 from vitax.telemetry import Watchdog, build_recorder
@@ -33,11 +34,11 @@ from vitax.telemetry.watchdog import EXIT_HANG
 from vitax.utils.logging import master_print, memory_summary
 from vitax.utils.metrics import SmoothedValue
 
-# Multi-host preemption-flag sync cadence (steps). Bounds the extra exposure
-# after SIGTERM to min(10 steps, rest of the epoch) of wall time — the epoch
-# boundary always syncs too. Hosts must use the SAME constant (the flag sync
-# is a collective).
-PREEMPT_SYNC_STEPS = 10
+# Multi-host failure-signal agreement (SIGTERM preemption, watchdog
+# escalation, fault/peer-loss bits) is the control plane's job now:
+# vitax/train/control.py folds them into one packed word agreed across hosts
+# every --control_sync_steps steps (plus each epoch boundary) — the same
+# single tiny collective the preemption-only flag sync used to cost.
 
 
 def _sharded_param_count(state: TrainState) -> int:
@@ -97,16 +98,24 @@ def train(cfg: Config) -> TrainState:
     # step-granular resume: a mid-epoch (preemption) checkpoint carries the
     # completed step count in a sidecar — continue INSIDE that epoch instead
     # of skipping its remainder (improves on the reference's epoch-granular
-    # --resume_epoch contract, run_vit_training.py:246-248)
+    # --resume_epoch contract, run_vit_training.py:246-248). Elastic: a
+    # checkpoint written under a DIFFERENT process count resumes too
+    # (_elastic_resume — Orbax reshards the state; the step either carries
+    # over exactly or is epoch-rounded when a stream cursor pins topology)
     resume_step = 0
+    topology_change = None  # (from, to) process counts when they differ
     if cfg.resume_epoch > 0:
-        from vitax.checkpoint.orbax_io import load_resume_step
-        resume_step = distributed.broadcast_from_process0(
-            load_resume_step(cfg.ckpt_dir, cfg.resume_epoch) or 0)
+        resume_step, topology_change = _elastic_resume(cfg, cfg.resume_epoch)
     model = build_model(cfg, attention_impl=attention_impl,
                         token_sharding=_token_sharding(cfg, mesh),
                         moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh))
-    steps_per_epoch = cfg.steps_per_epoch or (len(train_ds) // cfg.batch_size)
+    # re-derived from the LIVE loader each run (not a checkpointed value):
+    # an elastic restart under a different topology gets the cadence its
+    # CURRENT shard assignment supports (the stream sampler's steps_per_epoch
+    # depends on process count; the index-sampled loaders' does not)
+    steps_per_epoch = (cfg.steps_per_epoch
+                       or getattr(train_loader, "steps_per_epoch", 0)
+                       or (len(train_ds) // cfg.batch_size))
     max_iteration = steps_per_epoch * cfg.num_epochs
     tx, schedule = build_optimizer(cfg, max_iteration)
     # On resume, build only the ABSTRACT state (no device materialization — the
@@ -122,9 +131,7 @@ def train(cfg: Config) -> TrainState:
                 cfg.ckpt_dir, cfg.resume_epoch, state)
             if restored != cfg.resume_epoch:
                 cfg = dataclasses.replace(cfg, resume_epoch=restored)
-                from vitax.checkpoint.orbax_io import load_resume_step
-                resume_step = distributed.broadcast_from_process0(
-                    load_resume_step(cfg.ckpt_dir, restored) or 0)
+                resume_step, topology_change = _elastic_resume(cfg, restored)
         else:  # an explicit --resume_epoch N must mean N — fail hard
             state = restore_state(cfg.ckpt_dir, cfg.resume_epoch, state)
     distributed.barrier("loaded model")
@@ -194,6 +201,45 @@ def train(cfg: Config) -> TrainState:
             + (f", then emergency checkpoint + exit {EXIT_HANG}"
                if cfg.hang_action == "checkpoint_exit" else ""))
 
+    # --- coordinated failure control plane (vitax/train/control.py): every
+    # host-local signal — preemption, escalation, fault, peer loss — folds
+    # into one packed word agreed across hosts, so all hosts take the same
+    # action at the same step. Host-side only, like telemetry and faults:
+    # the compiled step program is identical with the plane on or off. ---
+    control = ControlPlane(
+        sync_steps=cfg.control_sync_steps, watchdog=watchdog,
+        on_event=((lambda payload: recorder.event("control", **payload))
+                  if recorder is not None else None))
+    if watchdog is not None:
+        # last words for the hard-deadline exit: a flushed telemetry event
+        # plus the fault bit published through the coordination service, so
+        # peers learn the cause instead of just losing a heartbeat
+        def _hard_exit_last_words(payload, _recorder=recorder,
+                                  _control=control):
+            if _recorder is not None:
+                _recorder.event("hang_hard_exit", **payload)
+            _control.publish_fault("hang_hard_exit")
+        watchdog.on_hard_exit = _hard_exit_last_words
+    if topology_change is not None and recorder is not None:
+        # the RESUME action, not the observation: the supervisor already
+        # records `topology_change` when it spots the mismatch — this one
+        # says the loop actually re-derived its schedule under the new
+        # process count (distinct events, or the report double-counts)
+        recorder.event("control", event="elastic_resume",
+                       from_processes=topology_change[0],
+                       to_processes=topology_change[1],
+                       epoch=cfg.resume_epoch, resume_step=resume_step)
+    if cfg.peer_heartbeat_s > 0:
+        grace_s = cfg.peer_grace_s or 10.0 * cfg.peer_heartbeat_s
+        if control.start_liveness(cfg.peer_heartbeat_s, grace_s):
+            master_print(
+                f"peer liveness: heartbeats every {cfg.peer_heartbeat_s:g}s "
+                f"through the coordination service; a peer silent for "
+                f"{grace_s:g}s is declared lost and survivors exit "
+                f"{EXIT_HANG} within the deadline instead of blocking in "
+                f"collectives")
+
+    control.warmup()  # compile the agreement fold outside any hang deadline
     distributed.barrier("training begins")
     master_print("training begins (the first few iterations are very slow due to compilation)")
 
@@ -202,11 +248,31 @@ def train(cfg: Config) -> TrainState:
         state = _run_epochs(
             cfg, state, train_step, train_loader, val_loader, eval_step,
             schedule, smoothed_loss, smoothed_time, prof,
-            resume_step=resume_step, recorder=recorder, watchdog=watchdog)
+            resume_step=resume_step, recorder=recorder, watchdog=watchdog,
+            control=control)
+    except Exception as e:  # noqa: BLE001 — classify, then exit coordinated or re-raise
+        # A dead peer shows up two ways: ICI collectives BLOCK on it (the
+        # liveness deadline timer bounds that), host-plane transports like
+        # Gloo surface it as a runtime ERROR instead. Ask the liveness
+        # monitor which this is: a lost-peer verdict means the error is the
+        # death itself — exit EXIT_HANG like every other coordinated
+        # escalation (the last committed checkpoint stands; no joint save is
+        # possible over a dead peer). Peers all beating == a genuine bug:
+        # re-raise it untouched.
+        lost = control.peer_loss_suspected()
+        if lost is None:
+            raise
+        import sys as _sys
+        print(f"vitax.control: runtime error after losing peer {lost} "
+              f"({type(e).__name__}: {e}); exiting {EXIT_HANG} for the "
+              f"supervisor to restart from the last committed checkpoint",
+              file=_sys.stderr, flush=True)
+        raise SystemExit(EXIT_HANG) from e
     finally:
         if prof["on"]:
             jax.profiler.stop_trace()
             master_print(f"profile trace written to {cfg.profile_dir}")
+        control.stop()  # liveness threads + any armed peer-loss exit timer
         if watchdog is not None:
             watchdog.stop()  # before the loaders: their drain must not fire it
         train_loader.close()
@@ -253,27 +319,44 @@ def _verify_stream_resume(cfg, train_loader, resume_step: int) -> None:
                      f"{cursor.get('record_offset')}")
 
 
-def _preempt_agreed(step_in_epoch) -> bool:
-    """Did SIGTERM arrive, as agreed by ALL hosts? Single-host: the local flag
-    (free, checked every step). Multi-host: the flag sync is a collective, so
-    every host must call it at the same points — every PREEMPT_SYNC_STEPS
-    steps in the step loop, and unconditionally at each epoch boundary
-    (step_in_epoch=None) so epochs shorter than the cadence are still covered.
-    Without agreement, one host entering the save while others keep stepping
-    would interleave mismatched collectives and deadlock the pod."""
-    from vitax.train import preempt
-    if jax.process_count() == 1:
-        return preempt.requested()
-    on_cadence = (step_in_epoch is None
-                  or (step_in_epoch + 1) % PREEMPT_SYNC_STEPS == 0)
-    if not on_cadence:
-        return False
-    return distributed.any_across_processes(preempt.requested())
+def _elastic_resume(cfg, epoch: int):
+    """Resume step for `epoch` under the CURRENT topology: (resume_step,
+    (from, to) process counts when they differ else None). Process 0 reads
+    the sidecar and plans (vitax/train/control.py elastic_resume_plan);
+    every process adopts its verdict — the same broadcast discipline as the
+    auto-resume epoch pick, so a non-atomic shared store can never let hosts
+    disagree on where the epoch re-enters."""
+    from vitax.checkpoint.orbax_io import load_resume_meta
+    from vitax.train.control import elastic_resume_plan
+    step = prev = 0
+    if jax.process_index() == 0:
+        plan = elastic_resume_plan(load_resume_meta(cfg.ckpt_dir, epoch),
+                                   jax.process_count())
+        step = plan.resume_step
+        if plan.topology_changed:
+            prev = plan.from_processes
+            master_print(
+                f"elastic resume: checkpoint epoch {epoch} was written by "
+                f"{plan.from_processes} process(es), this run has "
+                f"{jax.process_count()}"
+                + (f" — stream cursor invalidated by the topology change; "
+                   f"epoch-rounding the resume (re-running "
+                   f"{plan.skipped_steps} mid-epoch steps)"
+                   if plan.epoch_rounded else
+                   " — rank-interleaved sampling keeps the step-granular "
+                   "resume exact"))
+    step = distributed.broadcast_from_process0(step)
+    prev = distributed.broadcast_from_process0(prev)
+    return step, ((prev, jax.process_count()) if prev else None)
 
 
 def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 schedule, smoothed_loss, smoothed_time, prof,
-                resume_step: int = 0, recorder=None, watchdog=None):
+                resume_step: int = 0, recorder=None, watchdog=None,
+                control=None):
+    if control is None:  # direct callers (tests): a local, collective-free plane
+        control = ControlPlane(sync_steps=cfg.control_sync_steps,
+                               watchdog=watchdog)
     data_rng = jax.random.key(cfg.seed + 1)
     total_steps = 0
     steps_since_record = 0  # averaging window for the per-record data wait
@@ -315,12 +398,15 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 # pet on dispatch, not completion: the loop is alive; a wedged
                 # DEVICE stalls the next log step's fence, which stops pets
                 # within log_step_interval dispatches (async dispatch depth).
-                # The FIRST dispatch return starts the watchdog instead: it
-                # includes the XLA compile, which must not count as a stall
-                # (--hang_timeout_s stays independent of compile time).
+                # The FIRST step arms the watchdog instead — after its results
+                # MATERIALIZE, not at dispatch return: the first execution
+                # covers XLA compile and, multi-host, collective-transport
+                # bring-up + peer compile skew. None of that is a hang, and
+                # --hang_timeout_s stays independent of all of it.
                 if watchdog.running:
                     watchdog.pet()
                 else:
+                    jax.device_get(metrics["loss"])  # fence: bring-up done
                     watchdog.start()
             if prof["on"] and total_steps == prof_stop:
                 jax.device_get(metrics["loss"])  # fence (block_until_ready is
@@ -363,24 +449,34 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                                      / max(steps_since_record, 1)),
                         grad_norm=float(jax.device_get(metrics["grad_norm"])))
                 steps_since_record = 0
-            if watchdog is not None and watchdog.escalation_requested():
-                # --hang_action checkpoint_exit: the watchdog flagged a hang
-                # (flag-then-poll like preempt.py — its thread must never
-                # touch device state); save a committed mid-epoch checkpoint
-                # and exit EXIT_HANG for the supervisor to restart. The
-                # acknowledge re-arms the watchdog's hard deadline so a save
-                # wedged on a truly dead device is still bounded.
-                watchdog.acknowledge_escalation()
+            # step-boundary control poll (vitax/train/control.py): folds the
+            # watchdog's escalation flag, the SIGTERM flag, and fault/peer
+            # bits into one word — agreed across hosts on the sync cadence,
+            # free local reads single-host — so every host reacts to the
+            # SAME verdict at the SAME step
+            sig = control.poll(step_in_epoch=step, epoch=epoch)
+            if sig.emergency:
+                # agreed hang escalation / fault / peer-loss verdict: save a
+                # jointly committed mid-epoch checkpoint and exit EXIT_HANG
+                # on ALL hosts for the supervisor to restart. Reaching this
+                # agreement proves every process is alive, so the joint
+                # save's collectives line up; the acknowledge re-arms the
+                # watchdog's hard deadline so a save wedged on a truly dead
+                # device is still bounded.
+                if watchdog is not None:
+                    watchdog.acknowledge_escalation()
                 master_print(f"watchdog escalation: saving emergency "
                              f"checkpoint at epoch {epoch} (step {step + 1}) "
-                             f"and exiting with code {EXIT_HANG}")
+                             f"and exiting with code {EXIT_HANG} "
+                             f"(agreed signals: {sig.describe()})")
                 jax.device_get(metrics["loss"])  # fence: step must be done
                 save_state(cfg.ckpt_dir, epoch, state, wait=True,
                            step_in_epoch=step + 1,
                            stream_cursor=_stream_cursor(train_loader, epoch,
                                                         step + 1))
+                distributed.barrier("coordinated emergency exit")
                 raise SystemExit(EXIT_HANG)
-            if _preempt_agreed(step_in_epoch=step):
+            if sig.preempt:
                 # commit a synchronous save of the live mid-epoch state under
                 # this epoch's name (with the completed step count in the
                 # resume sidecar), drain, and leave. Auto-resume
@@ -393,6 +489,7 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                            step_in_epoch=step + 1,
                            stream_cursor=_stream_cursor(train_loader, epoch,
                                                         step + 1))
+                distributed.barrier("coordinated preemption exit")
                 return state
             if cfg.max_steps and total_steps >= cfg.max_steps:
                 break
@@ -401,12 +498,24 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             jax.device_get(metrics["loss"])  # fence: honest epoch wall time
         master_print(f"epoch {epoch} done ({time.time() - time_epoch_b:.2f} sec)")
 
-        if _preempt_agreed(step_in_epoch=None):  # epoch boundary: always sync
-            # epochs shorter than the in-loop cadence still get a preemption
-            # save here (every host reaches the boundary at the same point)
+        # epoch boundary: always sync — epochs shorter than the in-loop
+        # cadence still get an agreed verdict here (every host reaches the
+        # boundary at the same point)
+        sig = control.poll(step_in_epoch=None, epoch=epoch)
+        if sig.emergency:
+            if watchdog is not None:
+                watchdog.acknowledge_escalation()
+            master_print(f"watchdog escalation: saving emergency checkpoint "
+                         f"after epoch {epoch} and exiting with code "
+                         f"{EXIT_HANG} (agreed signals: {sig.describe()})")
+            save_state(cfg.ckpt_dir, epoch, state, wait=True)
+            distributed.barrier("coordinated emergency exit")
+            raise SystemExit(EXIT_HANG)
+        if sig.preempt:
             master_print(f"SIGTERM received: saving preemption checkpoint "
                          f"after epoch {epoch} and exiting")
             save_state(cfg.ckpt_dir, epoch, state, wait=True)
+            distributed.barrier("coordinated preemption exit")
             return state
 
         if epoch % cfg.ckpt_epoch_interval == 0 or epoch == cfg.num_epochs:
