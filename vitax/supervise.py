@@ -25,7 +25,8 @@ checkpoint, vitax/checkpoint/orbax_io.py), then:
 - detects ELASTIC (topology-change) restarts: when the checkpoint frontier's
   sidecar records a different process count than the one the next child
   launch runs under (``--expect_processes``, default: the JAX_NUM_PROCESSES
-  bring-up env var, else 1), the supervisor announces it loudly and appends
+  bring-up env var, else checking stays off — TPU pods auto-detect their
+  topology without the var), the supervisor announces it loudly and appends
   a ``kind:"control"`` ``topology_change`` event — the child's own
   elastic-resume path (vitax/train/control.py) re-derives steps_per_epoch
   and remaps or epoch-rounds the stream cursor, so an N-host checkpoint
@@ -160,11 +161,17 @@ def checkpoint_topology(ckpt_dir: str) -> Optional[int]:
 
 def expected_process_count() -> int:
     """The topology the next child launch will run under: the explicit
-    bring-up env var (the same one vitax/distributed.py reads), else 1.
-    The supervisor launches the child with its own inherited environment,
-    so this is exactly what jax.process_count() will say in the child."""
+    bring-up env var (the same one vitax/distributed.py reads), else 0 =
+    topology checking OFF. The supervisor launches the child with its own
+    inherited environment, so when the var is set this is exactly what
+    jax.process_count() will say in the child. When it is absent the child
+    may still be multi-process (TPU pods auto-detect their topology from
+    platform metadata, never setting the var) — guessing 1 would flag a
+    spurious TOPOLOGY CHANGE against the sidecar's real process count on
+    every restart, so the supervisor stays quiet unless told
+    --expect_processes explicitly."""
     nproc = os.environ.get("JAX_NUM_PROCESSES", "")
-    return int(nproc) if nproc.isdigit() and int(nproc) >= 1 else 1
+    return int(nproc) if nproc.isdigit() and int(nproc) >= 1 else 0
 
 
 class Supervisor:
@@ -385,8 +392,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="process count the child launches with, for elastic "
                         "(topology-change) restart detection against the "
                         "checkpoint frontier's recorded topology (default "
-                        "0 = read JAX_NUM_PROCESSES from the environment, "
-                        "else 1)")
+                        "0 = read JAX_NUM_PROCESSES from the environment; "
+                        "when that is unset too — e.g. TPU pods that "
+                        "auto-detect their topology — checking stays off)")
     return p
 
 

@@ -163,6 +163,17 @@ class Watchdog:
         save itself runs under the same bounded protection."""
         self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
 
+    def arm_exit_deadline(self) -> None:
+        """Bound a blocking exit-path collective (the coordinated preemption
+        barrier in train/loop.py): arm the hard-exit deadline WITHOUT
+        requesting escalation — works under any --hang_action. A peer that
+        died mid-save would otherwise wedge this host in the barrier
+        forever; with the deadline armed the watchdog hard-exits EXIT_HANG
+        and the supervisor restarts from the checkpoint this host just
+        committed. A clean barrier return is followed by stop(), which
+        halts the watchdog thread long before the deadline can fire."""
+        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:

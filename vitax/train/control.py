@@ -478,7 +478,14 @@ class PeerLiveness:
         # beat) still gets flagged: the grace clock starts at monitor start
         last_seen: Dict[int, tuple] = {p: (None, self.clock())
                                        for p in self.peers}
-        timeout_ms = max(int(min(self.interval_s, 2.0) * 1000), 50)
+        # near-non-blocking per-peer reads, INDEPENDENT of interval_s: the
+        # peers are polled serially, so a cycle over P peers costs up to
+        # P x timeout when they are all slow/missing — at 2s each a large
+        # pod's loss verdict would land whole multiples of grace_s late and
+        # overstate the silence it reports. 200ms keeps a full cycle short
+        # (a healthy-but-slow read counts as "no advance" for ONE cycle;
+        # the grace window, not a single read, decides loss).
+        timeout_ms = max(int(min(self.interval_s, 0.2) * 1000), 50)
         while not self._stop.wait(self.interval_s):
             now = self.clock()
             for peer in self.peers:

@@ -104,8 +104,10 @@ def train(cfg: Config) -> TrainState:
     # over exactly or is epoch-rounded when a stream cursor pins topology)
     resume_step = 0
     topology_change = None  # (from, to) process counts when they differ
+    resume_rounded = False  # cursor invalidated -> re-enter the SAME epoch
     if cfg.resume_epoch > 0:
-        resume_step, topology_change = _elastic_resume(cfg, cfg.resume_epoch)
+        resume_step, topology_change, resume_rounded = _elastic_resume(
+            cfg, cfg.resume_epoch)
     model = build_model(cfg, attention_impl=attention_impl,
                         token_sharding=_token_sharding(cfg, mesh),
                         moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh))
@@ -131,7 +133,8 @@ def train(cfg: Config) -> TrainState:
                 cfg.ckpt_dir, cfg.resume_epoch, state)
             if restored != cfg.resume_epoch:
                 cfg = dataclasses.replace(cfg, resume_epoch=restored)
-                resume_step, topology_change = _elastic_resume(cfg, restored)
+                resume_step, topology_change, resume_rounded = (
+                    _elastic_resume(cfg, restored))
         else:  # an explicit --resume_epoch N must mean N — fail hard
             state = restore_state(cfg.ckpt_dir, cfg.resume_epoch, state)
     distributed.barrier("loaded model")
@@ -228,7 +231,8 @@ def train(cfg: Config) -> TrainState:
         recorder.event("control", event="elastic_resume",
                        from_processes=topology_change[0],
                        to_processes=topology_change[1],
-                       epoch=cfg.resume_epoch, resume_step=resume_step)
+                       epoch=cfg.resume_epoch, resume_step=resume_step,
+                       epoch_rounded=resume_rounded)
     if cfg.peer_heartbeat_s > 0:
         grace_s = cfg.peer_grace_s or 10.0 * cfg.peer_heartbeat_s
         if control.start_liveness(cfg.peer_heartbeat_s, grace_s):
@@ -248,8 +252,8 @@ def train(cfg: Config) -> TrainState:
         state = _run_epochs(
             cfg, state, train_step, train_loader, val_loader, eval_step,
             schedule, smoothed_loss, smoothed_time, prof,
-            resume_step=resume_step, recorder=recorder, watchdog=watchdog,
-            control=control)
+            resume_step=resume_step, resume_rounded=resume_rounded,
+            recorder=recorder, watchdog=watchdog, control=control)
     except Exception as e:  # noqa: BLE001 — classify, then exit coordinated or re-raise
         # A dead peer shows up two ways: ICI collectives BLOCK on it (the
         # liveness deadline timer bounds that), host-plane transports like
@@ -320,19 +324,23 @@ def _verify_stream_resume(cfg, train_loader, resume_step: int) -> None:
 
 
 def _elastic_resume(cfg, epoch: int):
-    """Resume step for `epoch` under the CURRENT topology: (resume_step,
-    (from, to) process counts when they differ else None). Process 0 reads
-    the sidecar and plans (vitax/train/control.py elastic_resume_plan);
+    """Resume plan for `epoch` under the CURRENT topology: (resume_step,
+    (from, to) process counts when they differ else None, epoch_rounded).
+    `epoch_rounded` True means the mid-epoch progress was dropped — the loop
+    must RE-ENTER `epoch` from step 0, not treat the save as an epoch
+    boundary (which would skip the epoch's remaining records). Process 0
+    reads the sidecar and plans (vitax/train/control.py elastic_resume_plan);
     every process adopts its verdict — the same broadcast discipline as the
     auto-resume epoch pick, so a non-atomic shared store can never let hosts
     disagree on where the epoch re-enters."""
     from vitax.checkpoint.orbax_io import load_resume_meta
     from vitax.train.control import elastic_resume_plan
-    step = prev = 0
+    step = prev = rounded = 0
     if jax.process_index() == 0:
         plan = elastic_resume_plan(load_resume_meta(cfg.ckpt_dir, epoch),
                                    jax.process_count())
         step = plan.resume_step
+        rounded = int(plan.epoch_rounded)
         if plan.topology_changed:
             prev = plan.from_processes
             master_print(
@@ -347,13 +355,14 @@ def _elastic_resume(cfg, epoch: int):
                    "resume exact"))
     step = distributed.broadcast_from_process0(step)
     prev = distributed.broadcast_from_process0(prev)
-    return step, ((prev, jax.process_count()) if prev else None)
+    rounded = bool(distributed.broadcast_from_process0(rounded))
+    return step, ((prev, jax.process_count()) if prev else None), rounded
 
 
 def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 schedule, smoothed_loss, smoothed_time, prof,
-                resume_step: int = 0, recorder=None, watchdog=None,
-                control=None):
+                resume_step: int = 0, resume_rounded: bool = False,
+                recorder=None, watchdog=None, control=None):
     if control is None:  # direct callers (tests): a local, collective-free plane
         control = ControlPlane(sync_steps=cfg.control_sync_steps,
                                watchdog=watchdog)
@@ -367,12 +376,21 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
     # resume_step > 0: the resume checkpoint was a mid-epoch preemption save —
     # re-enter THAT epoch at the recorded step (the sampler order is a pure
     # function of (seed, epoch), so the data stream continues exactly where
-    # the preempted run left off)
-    start_epoch = cfg.resume_epoch + (0 if resume_step else 1)
+    # the preempted run left off). resume_rounded: the save was ALSO
+    # mid-epoch, but a topology change invalidated its stream cursor — the
+    # planner dropped the step, so re-enter the SAME epoch from step 0
+    # (treating it as an epoch boundary would silently skip the epoch's
+    # remaining records, the opposite of the rounding contract).
+    reenter = bool(resume_step) or resume_rounded
+    start_epoch = cfg.resume_epoch + (0 if reenter else 1)
     if resume_step:
         master_print(f"step-granular resume: re-entering epoch {start_epoch} "
                      f"at step {resume_step + 1}")
         _verify_stream_resume(cfg, train_loader, resume_step)
+    elif resume_rounded:
+        master_print(f"epoch-rounded resume: re-running epoch {start_epoch} "
+                     f"from step 1 (mid-epoch stream cursor invalidated by "
+                     f"the topology change)")
     for epoch in range(max(start_epoch, 1), cfg.num_epochs + 1):
         master_print(f"starting epoch {epoch}")
         time_epoch_b = time_step_b = time.time()
@@ -489,6 +507,12 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                            step_in_epoch=step + 1,
                            stream_cursor=_stream_cursor(train_loader, epoch,
                                                         step + 1))
+                # bounded: a peer that died mid-save must not wedge this
+                # host in the barrier forever — arm the watchdog's hard
+                # deadline (works under any --hang_action; without a
+                # watchdog, --hang_timeout_s 0, the barrier is unbounded)
+                if watchdog is not None and watchdog.running:
+                    watchdog.arm_exit_deadline()
                 distributed.barrier("coordinated preemption exit")
                 return state
             if cfg.max_steps and total_steps >= cfg.max_steps:
@@ -515,6 +539,8 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             master_print(f"SIGTERM received: saving preemption checkpoint "
                          f"after epoch {epoch} and exiting")
             save_state(cfg.ckpt_dir, epoch, state, wait=True)
+            if watchdog is not None and watchdog.running:
+                watchdog.arm_exit_deadline()  # bound the barrier (see above)
             distributed.barrier("coordinated preemption exit")
             return state
 
