@@ -559,7 +559,7 @@ def test_metrics_report_folds_control_events(tmp_path):
     assert summary["control_events"] == {
         "agreed_preemptions": 1, "agreed_escalations": 1,
         "peer_loss_detections": 1, "topology_changes": 1,
-        "elastic_resumes": 1}
+        "elastic_resumes": 1, "peer_restore_failures": 0}
     assert summary["hang_hard_exits"] == 1
 
     human = subprocess.run(

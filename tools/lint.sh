@@ -18,7 +18,9 @@ for path in vitax/telemetry tools/metrics_report.py \
             vitax/faults.py vitax/supervise.py tools/supervise.py \
             tests/test_faults.py \
             vitax/data/stream tools/make_shards.py tests/test_stream.py \
-            vitax/train/control.py tests/test_control.py; do
+            vitax/train/control.py tests/test_control.py \
+            vitax/checkpoint/snapshot.py vitax/checkpoint/peer.py \
+            tests/test_snapshot.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1

@@ -2,7 +2,8 @@
 """Summarize a vitax telemetry JSONL run (vitax/telemetry/, schema 1).
 
 Human mode prints the run at a glance — step range, p50/p95 sec/iter, MFU,
-data-wait fraction, throughput, a loss sparkline, memory peak, watchdog
+data-wait fraction, checkpoint-stall percentiles, peer-replication volume
+and restore path, throughput, a loss sparkline, memory peak, watchdog
 events; `--json` emits the same summary as one JSON object for CI.
 
     python tools/metrics_report.py /runs/exp7/metrics.jsonl
@@ -115,6 +116,20 @@ def summarize(path: str) -> dict:
     }
     summary["hang_hard_exits"] = sum(1 for e in events
                                      if e.get("kind") == "hang_hard_exit")
+    # zero-stall checkpointing + peer replication (vitax/checkpoint/
+    # snapshot.py + peer.py): replication volume, restore path taken, and
+    # whether any peer restore had to fall back to Orbax
+    repl = [e for e in events if e.get("kind") == "peer_replication"]
+    summary["peer_replication_windows"] = len(repl)
+    summary["peer_replication_bytes"] = sum(
+        int(e.get("bytes", 0)) for e in repl)
+    restores = [e for e in events if e.get("kind") == "restore"]
+    summary["peer_restores"] = sum(1 for e in restores
+                                   if e.get("path") == "peer")
+    summary["restore_path"] = (restores[-1].get("path")
+                               if restores else None)
+    summary["control_events"]["peer_restore_failures"] = sum(
+        1 for e in control if e.get("event") == "peer_restore_failed")
     # supervisor restarts (vitax/supervise.py appends these between child
     # runs, so they interleave with the child's own records)
     restarts = [e for e in events if e.get("kind") == "restart"]
@@ -133,6 +148,7 @@ def summarize(path: str) -> dict:
     losses = [r["loss"] for r in steps]
     mfus = [r["mfu"] for r in steps if "mfu" in r]
     waits = [r.get("data_wait_s", 0.0) for r in steps]
+    stalls = sorted(r["ckpt_stall_s"] for r in steps if "ckpt_stall_s" in r)
     # fraction of each recorded step spent waiting on host data (both sides
     # are per-step averages over the same record interval)
     wait_fracs = [r["data_wait_s"] / r["sec_per_iter"] for r in steps
@@ -145,6 +161,12 @@ def summarize(path: str) -> dict:
         "mfu_last": round(mfus[-1], 6) if mfus else None,
         "mfu_max": round(max(mfus), 6) if mfus else None,
         "data_wait_s_mean": round(sum(waits) / len(waits), 6),
+        # zero-stall checkpointing acceptance metric: staging time charged
+        # to the loop thread per step; ~0 unless a save was synchronous
+        "ckpt_stall_s_p50": (round(percentile(stalls, 0.50), 6)
+                             if stalls else None),
+        "ckpt_stall_s_p95": (round(percentile(stalls, 0.95), 6)
+                             if stalls else None),
         "data_wait_fraction": (round(sum(wait_fracs) / len(wait_fracs), 6)
                                if wait_fracs else None),
         # the streaming data plane's acceptance metric (ROADMAP item 3):
@@ -185,6 +207,17 @@ def print_human(summary: dict) -> None:
               f"escalation(s), {ce['peer_loss_detections']} peer loss(es), "
               f"{ce['topology_changes']} topology change(s), "
               f"{ce['elastic_resumes']} elastic resume(s)")
+    if ce.get("peer_restore_failures"):
+        print(f"  !! peer restores that fell back to Orbax: "
+              f"{ce['peer_restore_failures']}")
+    if summary.get("peer_replication_windows"):
+        print(f"  peer replication: {summary['peer_replication_windows']} "
+              f"window(s), "
+              f"{summary['peer_replication_bytes'] / 1024 ** 2:.2f} MiB "
+              f"mirrored to buddies")
+    if summary.get("restore_path"):
+        print(f"  restore path: {summary['restore_path']} "
+              f"({summary['peer_restores']} peer restore(s))")
     if summary.get("hang_hard_exits"):
         print(f"  !! watchdog hard-deadline exits: "
               f"{summary['hang_hard_exits']}")
@@ -213,6 +246,9 @@ def print_human(summary: dict) -> None:
         print(f"  data wait: {summary['data_wait_s_mean']:.4f}s/step, "
               f"{100 * summary['data_wait_fraction']:.1f}% of step "
               f"time{starved}")
+    if summary.get("ckpt_stall_s_p50") is not None:
+        print(f"  ckpt stall: p50 {summary['ckpt_stall_s_p50']:.4f}s  "
+              f"p95 {summary['ckpt_stall_s_p95']:.4f}s per step")
     if summary.get("input_bound") is not None:
         flag = " (!!)" if summary["input_bound"] > 0 else ""
         print(f"  input-bound steps (wait > 10% of step): "

@@ -35,6 +35,14 @@ Finding codes (Error Prone style: stable ids, CI-greppable):
                  deadlock); read the AGREED word via vitax/train/control.py
                  ControlPlane.poll instead. The control plane's own two
                  polls are the sanctioned (suppressed) call sites.
+  VTX108  ERROR  `save_state(..., wait=True)` inside a loop body — a
+                 synchronous checkpoint write from the step-dispatch region
+                 stalls the train loop for the full serialization+write
+                 (the exact stall the zero-stall snapshot pipeline exists
+                 to remove, vitax/checkpoint/snapshot.py); route the save
+                 through SnapshotPipeline.submit, or hoist it out of the
+                 loop (the final boundary save may wait — it is not inside
+                 one)
 
 Suppression: append `# vtx: ignore[VTX101] <reason>` to the offending line.
 Multiple codes: `# vtx: ignore[VTX101,VTX103] <reason>`. A suppression
@@ -133,6 +141,8 @@ class _Visitor(ast.NodeVisitor):
         self.findings: List[Finding] = []
         # (lineno, kind) events per function for the VTX103 timing check
         self._func_stack: List[List[Tuple[int, str]]] = []
+        # loop-nesting depth for the VTX108 in-loop synchronous-save check
+        self._loop_depth = 0
 
     def _add(self, code: str, severity: str, node: ast.AST, msg: str) -> None:
         self.findings.append(
@@ -154,6 +164,15 @@ class _Visitor(ast.NodeVisitor):
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
 
     def _check_timing(self, func, events: List[Tuple[int, str]]) -> None:
         timers = [ln for ln, kind in events if kind == "timer"]
@@ -234,6 +253,16 @@ class _Visitor(ast.NodeVisitor):
                       "host acting on its local flag desynchronizes the pod; "
                       "read the agreed word via vitax/train/control.py "
                       "ControlPlane.poll instead")
+
+        if short == "save_state" and self._loop_depth > 0 and any(
+                kw.arg == "wait" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in node.keywords):
+            self._add("VTX108", "ERROR", node,
+                      "`save_state(..., wait=True)` inside a loop body — a "
+                      "synchronous checkpoint write stalls the step-dispatch "
+                      "region; route it through SnapshotPipeline.submit "
+                      "(vitax/checkpoint/snapshot.py) or hoist it out of "
+                      "the loop")
 
         if short in ("devices", "local_devices") and name.startswith("jax.") \
                 and not node.args and not node.keywords:

@@ -3,6 +3,8 @@ from vitax.checkpoint.orbax_io import (  # noqa: F401
     epoch_ckpt_path,
     is_committed_checkpoint,
     latest_epoch,
+    prune_checkpoints,
+    restore_read_count,
     restore_state,
     restore_state_with_fallback,
     save_state,

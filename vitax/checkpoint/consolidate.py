@@ -98,13 +98,22 @@ def load_npz(path: str) -> Dict[str, np.ndarray]:
 
 def consolidate(ckpt_dir: str, epoch: int, out: str, params_only: bool = True,
                 dtype: Optional[str] = None) -> dict:
+    import jax
     import orbax.checkpoint as ocp
 
     from vitax.checkpoint.orbax_io import wait_until_finished
     wait_until_finished()  # same-process async save of this epoch must commit
     path = epoch_ckpt_path(ckpt_dir, epoch)
-    with ocp.StandardCheckpointer() as ckptr:
-        state = ckptr.restore(path)  # host restore: full numpy arrays
+    # Restore every leaf as a plain numpy array (restore_type=np.ndarray).
+    # A targetless restore would instead rebuild the SAVED device mesh from
+    # the sharding file — impossible on this host for a checkpoint written
+    # by a multi-host run (its device ids don't exist here). Consolidation
+    # must work from any single machine regardless of save topology.
+    with ocp.PyTreeCheckpointer() as ckptr:
+        meta = ckptr.metadata(path)
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta)
+        state = ckptr.restore(path, restore_args=restore_args)
     tree = state["params"] if params_only and "params" in state else state
     flat = save_npz(out, flatten_tree(tree), dtype=dtype)
     total = sum(v.size for v in flat.values())

@@ -69,6 +69,17 @@ DEFAULT_SAVE_RETRY_BACKOFF_S = 0.5
 
 _CKPTR: Optional[ocp.StandardCheckpointer] = None
 
+# Shared-storage restore counter (the peer-replication acceptance seam):
+# every restore_state call — the only path that READS checkpoint state from
+# shared storage — bumps it. The kill-and-resume drill asserts a peer-path
+# resume leaves it at ZERO (tests/test_snapshot.py).
+_RESTORE_READS = 0
+
+
+def restore_read_count() -> int:
+    """How many shared-storage checkpoint restores this process performed."""
+    return _RESTORE_READS
+
 
 def _checkpointer() -> ocp.StandardCheckpointer:
     """One persistent async checkpointer per process (construction is not
@@ -202,7 +213,9 @@ def latest_epoch(ckpt_dir: str) -> Optional[int]:
 def save_state(ckpt_dir: str, epoch: int, state: PyTree,
                wait: bool = False,
                step_in_epoch: Optional[int] = None,
-               stream_cursor: Optional[dict] = None) -> str:
+               stream_cursor: Optional[dict] = None,
+               keep: int = 0,
+               extra_meta: Optional[dict] = None) -> str:
     """Save the train state for `epoch`; all hosts write their shards in
     parallel (reference save_ckpt with master_only=False, utils.py:24-33).
 
@@ -227,7 +240,15 @@ def save_state(ckpt_dir: str, epoch: int, state: PyTree,
     VITAX_CKPT_SYNC=1 forces wait=True on EVERY save — for fault drills
     and tests where "the save returned" must mean "the checkpoint is
     durable" (an injected crash a few steps after an epoch boundary
-    would otherwise race the background commit nondeterministically)."""
+    would otherwise race the background commit nondeterministically).
+
+    keep > 0 enables checkpoint GC (--keep_checkpoints): after the save,
+    committed epoch dirs beyond the newest `keep` are pruned (process 0
+    only; torn/uncommitted dirs are never touched — see prune_checkpoints).
+
+    extra_meta merges additional fields into the mid-epoch resume sidecar
+    (e.g. the replication window a zero-stall run was using, so a resumed
+    run can see the cadence that produced its peer replicas)."""
     path = epoch_ckpt_path(ckpt_dir, epoch)
     wait = wait or os.environ.get("VITAX_CKPT_SYNC", "") == "1"
     ckptr = _checkpointer()
@@ -264,6 +285,8 @@ def save_state(ckpt_dir: str, epoch: int, state: PyTree,
                        "process_count": jax.process_count()}
             if stream_cursor is not None:
                 payload["stream_cursor"] = stream_cursor
+            if extra_meta:
+                payload.update(extra_meta)
             tmp = meta + f".tmp{os.getpid()}"
             with open(tmp, "w") as f:
                 f.write(json.dumps(payload))
@@ -273,16 +296,44 @@ def save_state(ckpt_dir: str, epoch: int, state: PyTree,
     master_print(f"checkpoint save {'committed' if wait else 'started'}: {path}"
                  + (f" (mid-epoch, {step_in_epoch} steps done)"
                     if step_in_epoch else ""))
+    if keep > 0 and jax.process_index() == 0:
+        prune_checkpoints(ckpt_dir, keep)
     return path
+
+
+def prune_checkpoints(ckpt_dir: str, keep: int) -> List[int]:
+    """Checkpoint GC (--keep_checkpoints): delete COMMITTED epoch dirs (and
+    their resume sidecars) beyond the newest `keep`. Torn/uncommitted dirs
+    are never touched — they are crash forensics and committed_epochs
+    already refuses to resume from them; deleting one could also race an
+    in-flight async commit of that very epoch. keep <= 0 is a no-op (keep
+    all). Returns the pruned epochs."""
+    if keep <= 0:
+        return []
+    import shutil
+    committed = committed_epochs(ckpt_dir)
+    doomed = committed[:-keep] if len(committed) > keep else []
+    for ep in doomed:
+        shutil.rmtree(epoch_ckpt_path(ckpt_dir, ep), ignore_errors=True)
+        try:
+            os.remove(_resume_meta_path(ckpt_dir, ep))
+        except OSError:
+            pass
+    if doomed:
+        master_print(f"checkpoint GC: pruned committed epoch(s) {doomed} "
+                     f"(--keep_checkpoints {keep})")
+    return doomed
 
 
 def restore_state(ckpt_dir: str, epoch: int, abstract_state: PyTree) -> PyTree:
     """Restore into the given abstract state (ShapeDtypeStructs carrying target
     shardings) — resharding across topologies as needed (reference load_ckpt,
     utils.py:37-43, without the same-topology restriction)."""
+    global _RESTORE_READS
     wait_until_finished()  # an in-flight save of this epoch must commit first
     path = epoch_ckpt_path(ckpt_dir, epoch)
     assert os.path.exists(path), f"checkpoint not found: {path}"
+    _RESTORE_READS += 1  # the peer-restore drill asserts this stays 0
     state = _checkpointer().restore(path, abstract_state)
     master_print(f"resumed from checkpoint {path}")
     return state

@@ -1,0 +1,645 @@
+"""Peer-replicated state shards (ROADMAP item 3b; Gemini, SOSP'23).
+
+Every `--replicate_steps` window, each host packs its staged state shard
+(vitax/checkpoint/snapshot.py HostSnapshot) into one checksummed blob,
+versioned by `(epoch, step_in_epoch, topology)`, spills it to its OWN local
+peer store, and mirrors it to its RING BUDDY — host i sends to (i+1) % N and
+therefore guards (i-1) % N — over the coordination-service KV store (host
+TCP; alive exactly when a peer's devices are not). After a lost host, the
+restarted pod negotiates a restore FROM the surviving buddies' stores:
+shared-storage checkpoint reads stay at ZERO (orbax_io.restore_read_count is
+the counter seam the drill asserts), and restore-to-training drops from a
+full Orbax round-trip to reading a few local files.
+
+Why a local store and not just KV: the KV namespace dies with the run's
+coordination service — a restarted pod starts a FRESH service, so replicas
+must live on the surviving hosts' disks (the Gemini design point: peer CPU
+memory / local disk, not shared storage). The KV store is only the
+transport; PeerStore under `--peer_dir` (default <ckpt_dir>/peerstore,
+VITAX_PEER_DIR overrides — per-host scratch in production) is the durable
+half. Each process uses the subdirectory p<rank>, so a shared tmpdir in
+tests behaves exactly like per-host disks: deleting p<rank> IS the lost
+host.
+
+Restore negotiation (`negotiate_restore`): every host publishes what its
+store holds, process 0 picks the newest version whose shards cover the full
+topology AND beat the Orbax frontier, holders serve any shard a host lacks
+(chunked over the same KV seam), and the final all-hosts gate is a
+`BIT_PEER_RESTORE` agreement fold (vitax/train/control.py
+agree_peer_restore) — survivors explicitly agree to serve/accept shards
+before anyone re-enters the step, so a host whose fetch failed can veto the
+peer path and drop the whole pod to the Orbax fallback coherently.
+
+Corruption: every blob carries a crc32; `PeerStore.load` verifies it (and
+fires the `peer_restore` fault site so drills can inject exactly this) and
+a mismatch raises PeerRestoreError — the loop falls back, loudly, to
+`restore_state_with_fallback` on the last committed Orbax epoch.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+import sys
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from vitax import faults
+from vitax.utils.logging import master_print
+
+PyTree = Any
+
+# raw bytes per KV chunk (base64 inflates 4/3; the coordination service
+# handles small values best — a 10B FSDP shard ships in a few hundred)
+CHUNK_BYTES = 1 << 18
+
+PEER_KEY_PREFIX = "vitax/peer"          # replication transport
+RESTORE_KEY_PREFIX = "vitax/restore"    # negotiation + shard serving
+
+# npz has no bfloat16: stored as uint16 bit-views, dtype restored from the
+# per-leaf manifest (same trick as consolidate.save_npz)
+_BF16 = "bfloat16"
+
+
+class PeerRestoreError(RuntimeError):
+    """A peer shard is missing, incomplete, or failed its checksum."""
+
+
+def ring_buddy(process_index: int, process_count: int) -> int:
+    """The host that RECEIVES this host's replica: (i + 1) % N."""
+    return (process_index + 1) % process_count
+
+
+def ring_guard(process_index: int, process_count: int) -> int:
+    """The host whose replica THIS host stores: (i - 1) % N."""
+    return (process_index - 1) % process_count
+
+
+def default_peer_root(ckpt_dir: str) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), "peerstore")
+
+
+def resolve_peer_dir(cfg, process_index: Optional[int] = None) -> str:
+    """This process's peer-store directory: VITAX_PEER_DIR env (per-host
+    scratch) > --peer_dir > <ckpt_dir>/peerstore, always suffixed with
+    p<rank> so one shared root still keeps per-host stores distinct."""
+    root = (os.environ.get("VITAX_PEER_DIR", "")
+            or getattr(cfg, "peer_dir", "")
+            or default_peer_root(cfg.ckpt_dir))
+    if process_index is None:
+        import jax
+        process_index = jax.process_index()
+    return os.path.join(root, f"p{process_index}")
+
+
+def progress_key(epoch: int, step_in_epoch: int) -> Tuple[int, int]:
+    """Comparable training progress. A boundary save of epoch e (step 0)
+    means e is COMPLETE — normalize it to (e + 1, 0) so it beats any
+    mid-epoch version (e, s) of the same epoch."""
+    epoch, step = int(epoch), int(step_in_epoch)
+    return (epoch + 1, 0) if step == 0 else (epoch, step)
+
+
+# -- pack / unpack ------------------------------------------------------------
+
+def pack_snapshot(snapshot, src: int) -> Tuple[dict, bytes]:
+    """HostSnapshot -> (meta, payload). The payload is one in-memory npz of
+    this host's unique shards; meta carries the version, the per-leaf shard
+    indices (so restore can place them globally), the resume fields the
+    elastic planner reads (step_in_epoch / process_count / stream_cursor —
+    meta doubles as a resume sidecar), and the payload crc32."""
+    arrays: Dict[str, np.ndarray] = {}
+    leaves = []
+    for leaf_i, spec in enumerate(snapshot.specs):
+        bufs = snapshot.buffers(leaf_i)
+        shards = []
+        for slot, index in enumerate(spec.indices):
+            key = f"a{leaf_i}_{slot}"
+            arr = bufs[slot]
+            arrays[key] = (arr.view(np.uint16) if str(arr.dtype) == _BF16
+                           else arr)
+            shards.append({"key": key,
+                           "index": [[int(a), int(b)] for a, b in index]})
+        leaves.append({"path": spec.path,
+                       "shape": [int(d) for d in spec.shape],
+                       "dtype": str(spec.dtype),
+                       "shards": shards})
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    meta = {
+        "version": list(snapshot.version),
+        "src": int(src),
+        "step_in_epoch": snapshot.step_in_epoch,
+        "process_count": snapshot.process_count,
+        "leaves": leaves,
+        "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        "nbytes": len(payload),
+    }
+    if snapshot.stream_cursor is not None:
+        meta["stream_cursor"] = snapshot.stream_cursor
+    return meta, payload
+
+
+def unpack_payload(meta: dict, payload: bytes) -> Dict[str, np.ndarray]:
+    """payload npz -> {key: array} with bf16 views restored per the meta."""
+    import ml_dtypes
+    bf16_keys = {sh["key"] for leaf in meta["leaves"]
+                 if leaf["dtype"] == _BF16 for sh in leaf["shards"]}
+    with np.load(io.BytesIO(payload)) as data:
+        return {k: (data[k].view(ml_dtypes.bfloat16) if k in bf16_keys
+                    else data[k])
+                for k in data.files}
+
+
+# -- local store --------------------------------------------------------------
+
+class PeerStore:
+    """<root>/host_<src>/{meta.json, shard.npz}: the durable replicas this
+    host holds — its own shard (self-spill) plus its ring guard's. Writes
+    are payload-first then atomic meta rename, so a meta.json always
+    describes a fully written payload."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+
+    def _dir(self, src: int) -> str:
+        return os.path.join(self.root, f"host_{int(src)}")
+
+    def put(self, meta: dict, payload: bytes) -> None:
+        d = self._dir(meta["src"])
+        os.makedirs(d, exist_ok=True)
+        blob = os.path.join(d, "shard.npz")
+        tmp = blob + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, blob)
+        mpath = os.path.join(d, "meta.json")
+        tmp = mpath + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(meta))
+        os.replace(tmp, mpath)
+
+    def holdings(self) -> Dict[int, dict]:
+        """{src: meta} for every readable replica in the store; unreadable
+        entries are skipped (a torn replica is a missing replica)."""
+        out: Dict[int, dict] = {}
+        if not os.path.isdir(self.root):
+            return out
+        for name in sorted(os.listdir(self.root)):
+            if not name.startswith("host_"):
+                continue
+            try:
+                with open(os.path.join(self.root, name, "meta.json")) as f:
+                    meta = json.load(f)
+                out[int(meta["src"])] = meta
+            except (OSError, ValueError, KeyError, json.JSONDecodeError):
+                continue
+        return out
+
+    def load(self, src: int,
+             expect_version: Optional[Tuple[int, int, int]] = None,
+             ) -> Tuple[dict, bytes]:
+        """Read + VERIFY one replica. Raises PeerRestoreError on a missing
+        file, a version mismatch, or a checksum failure. The `peer_restore`
+        fault site fires once per load — drills inject corruption/IO errors
+        exactly here."""
+        d = self._dir(src)
+        try:
+            faults.fire("peer_restore", index=int(src))
+            with open(os.path.join(d, "meta.json")) as f:
+                meta = json.load(f)
+            with open(os.path.join(d, "shard.npz"), "rb") as f:
+                payload = f.read()
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            raise PeerRestoreError(
+                f"peer shard for host {src} unreadable at {d}: "
+                f"{type(e).__name__}: {e}") from e
+        if (expect_version is not None
+                and tuple(meta.get("version", ())) != tuple(expect_version)):
+            raise PeerRestoreError(
+                f"peer shard for host {src} is version "
+                f"{meta.get('version')}, wanted {list(expect_version)}")
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        if crc != int(meta.get("crc32", -1)):
+            raise PeerRestoreError(
+                f"peer shard for host {src} FAILED its checksum "
+                f"(crc32 {crc:#x} != recorded {int(meta.get('crc32', 0)):#x})"
+                f" — replica at {d} is corrupt")
+        return meta, payload
+
+
+def store_frontier(root: str) -> Tuple[int, int]:
+    """(epoch, step) progress frontier across every per-process store under
+    `root` — the supervisor folds this into its crash-loop progress check so
+    peer-replicated progress counts even when no Orbax commit advanced."""
+    best = (0, 0)
+    if not os.path.isdir(root):
+        return best
+    for sub in sorted(os.listdir(root)):
+        d = os.path.join(root, sub)
+        if not (sub.startswith("p") and os.path.isdir(d)):
+            continue
+        for src, meta in PeerStore(d).holdings().items():
+            v = meta.get("version") or [0, 0, 0]
+            best = max(best, (int(v[0]), int(v[1])))
+    return best
+
+
+# -- KV transport -------------------------------------------------------------
+
+def _publish_blob(client, prefix: str, meta: dict, payload: bytes,
+                  gen: int) -> None:
+    """Chunked, base64 KV publication. Chunks land before the meta (the
+    receiver's trigger), so a reader never sees a meta whose chunks are
+    missing; `gen` versions the chunk keys so a reader mid-fetch of gen k
+    can never mix in gen k+1 bytes."""
+    chunks = [payload[i:i + CHUNK_BYTES]
+              for i in range(0, len(payload), CHUNK_BYTES)] or [b""]
+    for i, chunk in enumerate(chunks):
+        client.key_value_set(f"{prefix}/g{gen}/c{i}",
+                             base64.b64encode(chunk).decode("ascii"),
+                             allow_overwrite=True)
+    wire = dict(meta, gen=int(gen), n_chunks=len(chunks))
+    client.key_value_set(f"{prefix}/meta", json.dumps(wire),
+                         allow_overwrite=True)
+
+
+def _fetch_blob(client, prefix: str, timeout_ms: int,
+                min_gen: int = 0) -> Optional[Tuple[dict, bytes]]:
+    """Read the newest publication under `prefix`, or None (no meta yet /
+    gen not newer than `min_gen`). Raises PeerRestoreError when the chunks
+    fail the meta's checksum."""
+    try:
+        raw = client.blocking_key_value_get(f"{prefix}/meta", timeout_ms)
+    except Exception:  # noqa: BLE001 — no publication yet is the common case
+        return None
+    meta = json.loads(raw)
+    gen = int(meta.get("gen", 0))
+    if gen <= min_gen:
+        return None
+    try:
+        parts = [client.blocking_key_value_get(f"{prefix}/g{gen}/c{i}",
+                                               timeout_ms)
+                 for i in range(int(meta["n_chunks"]))]
+    except Exception as e:  # noqa: BLE001 — a vanished chunk is a failed fetch, not a crash
+        raise PeerRestoreError(
+            f"peer transport: chunk fetch under {prefix} gen {gen} failed "
+            f"({type(e).__name__}: {e})") from e
+    payload = b"".join(base64.b64decode(p) for p in parts)
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != int(meta.get("crc32", -1)):
+        raise PeerRestoreError(
+            f"peer transport: blob under {prefix} gen {gen} failed its "
+            f"checksum after reassembly")
+    return meta, payload
+
+
+# -- replication --------------------------------------------------------------
+
+class PeerReplicator:
+    """Owns one host's replication duties: self-spill + publish to the ring
+    buddy (replicate(), called from the snapshot pipeline's worker thread)
+    and a receiver thread that stores the guard's publications. Single
+    process degrades to self-spill only — the store still feeds
+    single-process peer restore and the supervisor's frontier."""
+
+    def __init__(self, store: PeerStore, process_index: int,
+                 process_count: int, client=None, on_event=None,
+                 poll_s: Optional[float] = None):
+        self.store = store
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.client = client
+        self.on_event = on_event
+        self.poll_s = (float(poll_s) if poll_s is not None
+                       else float(os.environ.get("VITAX_PEER_POLL_S", 2.0)))
+        self.buddy = ring_buddy(self.process_index, self.process_count)
+        self.guard = ring_guard(self.process_index, self.process_count)
+        self.bytes_replicated = 0
+        self.windows_replicated = 0
+        self._gen = 0
+        self._stop = threading.Event()
+        self._receiver: Optional[threading.Thread] = None
+
+    def replicate(self, snapshot) -> None:
+        """Pack + self-spill + publish one staged snapshot. Runs on the
+        snapshot pipeline's worker thread: none of this blocks a step."""
+        meta, payload = pack_snapshot(snapshot, src=self.process_index)
+        self.store.put(meta, payload)
+        if self.process_count > 1 and self.client is not None:
+            self._gen += 1
+            _publish_blob(self.client,
+                          f"{PEER_KEY_PREFIX}/{self.process_index}",
+                          meta, payload, self._gen)
+        self.bytes_replicated += len(payload)
+        self.windows_replicated += 1
+        self._emit("peer_replication", bytes=len(payload),
+                   version=list(snapshot.version), src=self.process_index,
+                   buddy=self.buddy)
+
+    def start_receiver(self) -> bool:
+        """Poll the ring guard's publications into the local store. No-op
+        (False) single-process or without a KV client."""
+        if self.process_count <= 1 or self.client is None:
+            return False
+        self._receiver = threading.Thread(target=self._receive, daemon=True,
+                                          name="vitax-peer-receiver")
+        self._receiver.start()
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._receiver is not None:
+            self._receiver.join(timeout=self.poll_s + 1.0)
+            self._receiver = None
+
+    def _receive(self) -> None:
+        last_gen = 0
+        prefix = f"{PEER_KEY_PREFIX}/{self.guard}"
+        timeout_ms = max(int(min(self.poll_s, 0.2) * 1000), 50)
+        while not self._stop.wait(self.poll_s):
+            try:
+                got = _fetch_blob(self.client, prefix, timeout_ms,
+                                  min_gen=last_gen)
+            except PeerRestoreError as e:
+                # a torn mid-publish read: next poll sees the complete gen
+                print(f"vitax.peer: receive from host {self.guard} failed "
+                      f"({e}); retrying next poll", file=sys.stderr,
+                      flush=True)
+                continue
+            if got is None:
+                continue
+            meta, payload = got
+            last_gen = int(meta.get("gen", last_gen))
+            self.store.put(meta, payload)
+
+    def _emit(self, kind: str, **payload) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(kind, payload)
+        except Exception as e:  # noqa: BLE001 — observability must not break replication
+            print(f"vitax.peer: event sink failed ({type(e).__name__}: {e})",
+                  file=sys.stderr, flush=True)
+
+
+# -- negotiated restore -------------------------------------------------------
+
+@dataclass(frozen=True)
+class RestorePlan:
+    """An agreed peer restore: which version to load and the sidecar-shaped
+    meta (step_in_epoch / process_count / stream_cursor) the elastic-resume
+    planner consumes (control.elastic_resume_plan)."""
+
+    version: Tuple[int, int, int]
+    meta: dict
+
+    @property
+    def epoch(self) -> int:
+        return int(self.version[0])
+
+
+def _complete_versions(holdings: Dict[int, dict]) -> List[Tuple]:
+    """Versions for which `holdings` covers EVERY shard of the version's
+    own recorded topology."""
+    by_version: Dict[Tuple, set] = {}
+    for src, meta in holdings.items():
+        v = tuple(int(x) for x in (meta.get("version") or ()))
+        if len(v) == 3:
+            by_version.setdefault(v, set()).add(int(src))
+    return [v for v, srcs in by_version.items()
+            if srcs >= set(range(v[2]))]
+
+
+def negotiate_restore(store: PeerStore, *, process_index: int,
+                      process_count: int, client=None, collective=None,
+                      orbax_frontier: Tuple[int, int] = (0, 0),
+                      timeout_s: float = 30.0,
+                      on_event=None) -> Optional[RestorePlan]:
+    """Agree (or decline) a restore from peer stores. Returns the agreed
+    RestorePlan, or None -> the caller uses the Orbax path.
+
+    Single-process: the newest complete local version beating the Orbax
+    frontier, no negotiation. Multi-process: publish holdings, adopt process
+    0's candidate, serve/fetch any shard a host lacks over KV, then gate the
+    verdict with the BIT_PEER_RESTORE agreement fold — every host enters the
+    peer path together or none does."""
+    holdings = store.holdings()
+
+    def best(cands: List[Tuple]) -> Optional[Tuple]:
+        ahead = [v for v in cands
+                 if progress_key(v[0], v[1]) >= tuple(orbax_frontier)]
+        return max(ahead, key=lambda v: progress_key(v[0], v[1]),
+                   default=None)
+
+    if process_count <= 1:
+        v = best([c for c in _complete_versions(holdings) if c[2] == 1])
+        if v is None:
+            return None
+        meta = next(m for m in holdings.values()
+                    if tuple(m.get("version", ())) == v)
+        return RestorePlan(version=v, meta=meta)
+
+    if client is None:
+        return None
+    deadline_ms = max(int(timeout_s * 1000), 1000)
+    # 1. everyone publishes what it holds
+    mine = {src: list(meta.get("version", ()))
+            for src, meta in holdings.items()}
+    client.key_value_set(f"{RESTORE_KEY_PREFIX}/holdings/{process_index}",
+                         json.dumps(mine), allow_overwrite=True)
+    # 2. process 0 reads all holdings, picks the candidate, broadcasts it
+    if process_index == 0:
+        merged: Dict[int, dict] = {}
+        per_host: Dict[int, dict] = {}
+        for pid in range(process_count):
+            try:
+                raw = client.blocking_key_value_get(
+                    f"{RESTORE_KEY_PREFIX}/holdings/{pid}", deadline_ms)
+                per_host[pid] = {int(s): v for s, v in json.loads(raw).items()}
+            except Exception:  # noqa: BLE001 — a host with no store publishes nothing useful
+                per_host[pid] = {}
+        for pid, held in per_host.items():
+            for src, v in held.items():
+                merged[src] = {"src": src, "version": v}
+        v = best(_complete_versions(merged))
+        plan_wire = {"version": list(v) if v else None, "holders": {
+            str(src): min(pid for pid, held in per_host.items()
+                          if tuple(held.get(src, ())) == v)
+            for src in (range(v[2]) if v else ())
+            if any(tuple(held.get(src, ())) == v
+                   for held in per_host.values())}}
+        client.key_value_set(f"{RESTORE_KEY_PREFIX}/plan",
+                             json.dumps(plan_wire), allow_overwrite=True)
+    try:
+        plan_wire = json.loads(client.blocking_key_value_get(
+            f"{RESTORE_KEY_PREFIX}/plan", deadline_ms))
+    except Exception:  # noqa: BLE001 — no plan within the deadline -> Orbax path
+        plan_wire = {"version": None}
+    version = plan_wire.get("version")
+    if version is None:
+        _agree(False, process_count, collective)
+        return None
+    version = tuple(int(x) for x in version)
+    holders = {int(s): int(p)
+               for s, p in (plan_wire.get("holders") or {}).items()}
+    # 3. serve what this host holds and others may lack; fetch what it lacks
+    local_ok = True
+    for src in range(version[2]):
+        have = tuple(holdings.get(src, {}).get("version", ())) == version
+        if have and holders.get(src) == process_index:
+            try:
+                meta, payload = store.load(src, expect_version=version)
+                _publish_blob(client, f"{RESTORE_KEY_PREFIX}/data/{src}",
+                              meta, payload, gen=1)
+            except PeerRestoreError as e:
+                print(f"vitax.peer: cannot serve shard {src}: {e}",
+                      file=sys.stderr, flush=True)
+                local_ok = False
+        elif not have:
+            try:
+                got = _wait_blob(client, f"{RESTORE_KEY_PREFIX}/data/{src}",
+                                 timeout_s)
+                if got is None:
+                    raise PeerRestoreError(
+                        f"shard {src} not served within {timeout_s:g}s")
+                store.put(*got)
+            except PeerRestoreError as e:
+                print(f"vitax.peer: fetch of shard {src} failed: {e}",
+                      file=sys.stderr, flush=True)
+                local_ok = False
+    # 4. the all-hosts gate: everyone enters the peer path, or no one does
+    agreed = _agree(local_ok, process_count, collective)
+    if on_event is not None:
+        try:
+            on_event("control", {"event": "peer_restore_negotiated",
+                                 "version": list(version),
+                                 "agreed": bool(agreed),
+                                 "local_ok": bool(local_ok)})
+        except Exception as e:  # noqa: BLE001 — observability must not block the restore
+            print(f"vitax.peer: restore event sink failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+    if not agreed:
+        return None
+    # src 0's meta carries the resume fields (its stream cursor is the one
+    # the Orbax sidecar convention records); the store was completed above
+    meta = store.holdings().get(0)
+    if meta is None or tuple(meta.get("version", ())) != version:
+        return None
+    return RestorePlan(version=version, meta=meta)
+
+
+def _wait_blob(client, prefix: str, timeout_s: float):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = _fetch_blob(client, prefix, timeout_ms=1000)
+        if got is not None:
+            return got
+        time.sleep(0.1)
+    return None
+
+
+def _agree(local_ok: bool, process_count: int, collective) -> bool:
+    from vitax.train.control import agree_peer_restore
+    return agree_peer_restore(local_ok, process_count=process_count,
+                              collective=collective)
+
+
+# -- restore ------------------------------------------------------------------
+
+def assemble_state(parts: List[Tuple[dict, bytes]],
+                   abstract_state: PyTree) -> PyTree:
+    """Rebuild the sharded global state from peer blobs. Every leaf must be
+    fully covered by the union of shard indices across `parts` (partial
+    coverage raises PeerRestoreError); placement onto devices goes through
+    make_array_from_callback against the abstract state's target shardings,
+    so restore is topology-aware exactly like the Orbax path."""
+    import jax
+    from vitax.checkpoint.snapshot import _path_str
+    per_path: Dict[str, Dict[Tuple, np.ndarray]] = {}
+    for meta, payload in parts:
+        arrays = unpack_payload(meta, payload)
+        for leaf in meta["leaves"]:
+            dest = per_path.setdefault(leaf["path"], {})
+            for sh in leaf["shards"]:
+                key = tuple((int(a), int(b)) for a, b in sh["index"])
+                dest.setdefault(key, arrays[sh["key"]])
+    leaves_kp, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+    out = []
+    for kp, aval in leaves_kp:
+        path = _path_str(kp)
+        shards = per_path.get(path)
+        if shards is None:
+            raise PeerRestoreError(f"no peer shard covers leaf {path!r}")
+        full = np.zeros(aval.shape, dtype=np.dtype(aval.dtype))
+        covered = 0
+        for key, arr in shards.items():
+            idx = tuple(slice(a, b) for a, b in key)
+            full[idx] = arr
+            covered += int(np.prod([b - a for a, b in key] or [1]))
+        need = int(np.prod(aval.shape or (1,)))
+        if covered < need:
+            raise PeerRestoreError(
+                f"leaf {path!r} only {covered}/{need} elements covered by "
+                f"peer shards — a replica is missing")
+        out.append(jax.make_array_from_callback(
+            aval.shape, aval.sharding, lambda idx, _f=full: _f[idx]))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def restore_from_store(store: PeerStore, plan: RestorePlan,
+                       abstract_state: PyTree) -> PyTree:
+    """Load + verify every shard of the plan's version from the LOCAL store
+    and assemble the state. Raises PeerRestoreError on any corruption."""
+    parts = [store.load(src, expect_version=plan.version)
+             for src in range(plan.version[2])]
+    return assemble_state(parts, abstract_state)
+
+
+def restore_state_preferring_peers(store: PeerStore, plan: RestorePlan,
+                                   ckpt_dir: str, orbax_epoch: int,
+                                   abstract_state: PyTree,
+                                   on_event=None) -> Tuple[PyTree, dict]:
+    """The loop's restore entry when a peer plan was agreed: peer shards
+    first; on ANY PeerRestoreError (checksum, missing file, bad coverage)
+    fall back LOUDLY to the last committed Orbax epoch through
+    restore_state_with_fallback. Returns (state, info) where info carries
+    {"path": "peer"|"orbax", "epoch": restored-epoch, ...} for the loop's
+    restore telemetry event."""
+    try:
+        state = restore_from_store(store, plan, abstract_state)
+        master_print(
+            f"restored from PEER shards: version {list(plan.version)} "
+            f"({plan.version[2]} replica(s) from {store.root}; zero "
+            f"shared-storage checkpoint reads)")
+        return state, {"path": "peer", "epoch": plan.epoch,
+                       "step_in_epoch": int(plan.version[1])}
+    except PeerRestoreError as e:
+        print(f"vitax.peer: PEER RESTORE FAILED ({e}); falling back to the "
+              f"last committed Orbax epoch", file=sys.stderr, flush=True)
+        if on_event is not None:
+            try:
+                on_event("control", {"event": "peer_restore_failed",
+                                     "version": list(plan.version),
+                                     "error": str(e),
+                                     "fallback_epoch": int(orbax_epoch)})
+            except Exception as sink_err:  # noqa: BLE001 — observability must not mask the fallback
+                print(f"vitax.peer: restore event sink failed "
+                      f"({type(sink_err).__name__}: {sink_err})",
+                      file=sys.stderr, flush=True)
+        if orbax_epoch <= 0:
+            raise RuntimeError(
+                "peer restore failed and no committed Orbax checkpoint "
+                "exists to fall back to") from e
+        from vitax.checkpoint.orbax_io import restore_state_with_fallback
+        state, restored = restore_state_with_fallback(
+            ckpt_dir, orbax_epoch, abstract_state)
+        return state, {"path": "orbax", "epoch": int(restored),
+                       "fallback_from": str(e)}

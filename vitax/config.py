@@ -33,6 +33,23 @@ class Config:
     ckpt_dir: str = "/tmp/vit_fsdp"
     resume_epoch: int = 0               # N = resume from epoch N; -1 = auto-resume latest checkpoint
     ckpt_epoch_interval: int = 10
+    zero_stall_ckpt: bool = False       # route saves through the zero-stall snapshot pipeline
+    #   (vitax/checkpoint/snapshot.py): device->host staging is the only
+    #   part on the loop thread; serialization + the Orbax write run on a
+    #   background worker, so step N+1 dispatches immediately (ckpt_stall_s
+    #   telemetry pins the stall ~0). The step program is bit-identical
+    #   with this flag on or off.
+    replicate_steps: int = 0            # >0: every N steps, mirror this host's staged state shard
+    #   (checksummed, versioned) to its ring-buddy host over the
+    #   coordination-service KV — after a lost host, elastic resume
+    #   restores from the surviving buddy with ZERO shared-storage reads
+    #   (vitax/checkpoint/peer.py). 0 = replication off.
+    peer_dir: str = ""                  # local peer-store root (default <ckpt_dir>/peerstore;
+    #   VITAX_PEER_DIR env overrides — point it at per-host scratch in
+    #   production, NOT shared storage)
+    keep_checkpoints: int = 0           # >0: checkpoint GC — prune committed epoch dirs beyond the
+    #   newest K after each successful save (torn dirs never touched);
+    #   0 = keep all (default)
     test_epoch_interval: int = 10
     log_step_interval: int = 20
 
@@ -376,6 +393,15 @@ class Config:
             "--peer_grace_s without --peer_heartbeat_s does nothing: the "
             "grace window bounds heartbeat silence, and no heartbeats are "
             "being sent")
+        assert self.replicate_steps >= 0, (
+            f"--replicate_steps must be >= 0 (0 = peer replication off), "
+            f"got {self.replicate_steps}")
+        assert self.keep_checkpoints >= 0, (
+            f"--keep_checkpoints must be >= 0 (0 = keep all), "
+            f"got {self.keep_checkpoints}")
+        assert not (self.peer_dir and self.replicate_steps == 0), (
+            "--peer_dir without --replicate_steps does nothing: the peer "
+            "store is only written by the replication window")
         if self.tensorboard:
             assert self.metrics_dir, (
                 "--tensorboard needs --metrics_dir: the TB event files live "
@@ -440,6 +466,29 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--ckpt_dir", type=str, default="/tmp/vit_fsdp")
     parser.add_argument("--resume_epoch", type=int, default=0)
     parser.add_argument("--ckpt_epoch_interval", type=int, default=10)
+    parser.add_argument("--zero_stall_ckpt", action="store_true",
+                        dest="zero_stall_ckpt",
+                        help="route checkpoint saves through the zero-stall "
+                             "snapshot pipeline (vitax/checkpoint/"
+                             "snapshot.py): staging on the loop thread, "
+                             "serialize + Orbax write on a background "
+                             "worker — step N+1 never waits for a "
+                             "non-final save")
+    parser.add_argument("--replicate_steps", type=int, default=0,
+                        help=">0: every N steps, mirror this host's staged "
+                             "state shard to its ring-buddy host over the "
+                             "coordination-service KV (vitax/checkpoint/"
+                             "peer.py) so a lost host restores from the "
+                             "surviving buddy without shared storage "
+                             "(0 = off)")
+    parser.add_argument("--peer_dir", type=str, default="",
+                        help="local peer-store root (default <ckpt_dir>/"
+                             "peerstore; VITAX_PEER_DIR env overrides) — "
+                             "per-host scratch, not shared storage")
+    parser.add_argument("--keep_checkpoints", type=int, default=0,
+                        help=">0: checkpoint GC — prune committed epoch "
+                             "dirs beyond the newest K after each save; "
+                             "torn dirs are never touched (0 = keep all)")
     parser.add_argument("--test_epoch_interval", type=int, default=10)
     parser.add_argument("--log_step_interval", type=int, default=20)
 

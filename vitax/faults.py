@@ -25,6 +25,11 @@ Sites (each a single host-side hook point; see the wiring modules):
               once per control-word agreement collective (vitax/train/
               control.py ControlPlane.poll) — a `hang` here starves the
               agreement exactly like a peer that died between cadences
+  peer_restore
+              once per peer-shard load during a peer restore, index = the
+              shard's source host (vitax/checkpoint/peer.py PeerStore.load)
+              — `oserror` drills the missing/corrupted-buddy fallback to
+              the last committed Orbax epoch
 
 Actions:
   crash    os._exit(exit_code) — a hard kill: no atexit, no drains, exactly
@@ -67,7 +72,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-SITES = ("step", "ckpt_write", "loader", "stream_read", "barrier_timeout")
+SITES = ("step", "ckpt_write", "loader", "stream_read", "barrier_timeout",
+         "peer_restore")
 ACTIONS = ("crash", "hang", "oserror", "stall", "sigterm", "peer_loss")
 
 DEFAULT_CRASH_EXIT_CODE = 13

@@ -11,8 +11,11 @@ checkpoint, vitax/checkpoint/orbax_io.py), then:
   escalation exit (code 42, vitax/telemetry/watchdog.py EXIT_HANG) — with
   capped exponential backoff and a total restart budget;
 - detects CRASH LOOPS: a child that dies without advancing the checkpoint
-  frontier (latest committed epoch + resume-step sidecar) is burning the
-  budget on a deterministic bug, not riding out flaky infrastructure — after
+  frontier (latest committed epoch + resume-step sidecar, maxed with the
+  peer-replication store's frontier when the child runs with
+  ``--replicate_steps`` — peer-restored progress is real progress even when
+  no Orbax commit advanced) is burning the budget on a deterministic bug,
+  not riding out flaky infrastructure — after
   ``crash_loop_tolerance`` consecutive no-progress deaths the supervisor
   gives up with EXIT_BUDGET (3) so the launcher sees a *distinct* failure;
 - forwards SIGTERM/SIGINT to the child exactly once for a clean preemption
@@ -143,6 +146,44 @@ def checkpoint_progress(ckpt_dir: str) -> Tuple[int, int]:
     return (latest, load_resume_step(ckpt_dir, latest) or 0)
 
 
+def peer_store_root(child_argv: Sequence[str], ckpt_dir: str) -> str:
+    """Root of the child's peer-replication store (PR 11, vitax/checkpoint/
+    peer.py), or "" when peer replication is off for this child command.
+    Same resolution order the child itself uses (peer.resolve_peer_dir,
+    minus the per-process suffix): VITAX_PEER_DIR env > --peer_dir >
+    <ckpt_dir>/peerstore — gated on --replicate_steps > 0 so supervising a
+    replication-free run never invents a phantom frontier directory."""
+    steps = scrape_flag(child_argv, "--replicate_steps")
+    try:
+        if int(steps or 0) <= 0:
+            return ""
+    except ValueError:
+        return ""
+    env = os.environ.get("VITAX_PEER_DIR", "")
+    if env:
+        return env
+    flagged = scrape_flag(child_argv, "--peer_dir")
+    if flagged:
+        return flagged
+    from vitax.checkpoint.peer import default_peer_root
+    return default_peer_root(ckpt_dir)
+
+
+def run_progress(ckpt_dir: str, peer_root: str = "") -> Tuple[int, int]:
+    """The combined durable-progress frontier: the Orbax checkpoint frontier
+    maxed with the peer-replication store's frontier (when one exists). A
+    child that died between Orbax commits but after a replication window
+    still made REAL progress — its shards live on the surviving buddies and
+    the next launch restores them without touching shared storage — so the
+    crash-loop detector must count it, or a run surviving on peer restores
+    would read as a crash loop and the supervisor would give up mid-save."""
+    progress = checkpoint_progress(ckpt_dir)
+    if peer_root:
+        from vitax.checkpoint.peer import store_frontier
+        progress = max(progress, store_frontier(peer_root))
+    return progress
+
+
 def checkpoint_topology(ckpt_dir: str) -> Optional[int]:
     """The process count that wrote the frontier checkpoint's mid-epoch
     sidecar, or None (boundary save, pre-PR-10 sidecar, no checkpoint).
@@ -193,7 +234,8 @@ class Supervisor:
                  sleep: Callable[[float], None] = time.sleep,
                  poll_interval_s: float = 0.1,
                  expect_processes: int = 0,
-                 topology_fn: Optional[Callable[[], Optional[int]]] = None):
+                 topology_fn: Optional[Callable[[], Optional[int]]] = None,
+                 peer_root: str = ""):
         assert max_restarts >= 0, max_restarts
         assert crash_loop_tolerance >= 0, crash_loop_tolerance
         assert backoff_s >= 0 and backoff_max_s >= 0
@@ -207,8 +249,11 @@ class Supervisor:
         self.term_grace_s = term_grace_s
         self.poll_interval_s = poll_interval_s
         self._spawn = spawn or (lambda argv: subprocess.Popen(argv))
+        # peer-replicated progress counts too: a child surviving on peer
+        # restores (no Orbax commit between deaths) is not a crash loop
+        self.peer_root = peer_root
         self._progress = progress_fn or (
-            lambda: checkpoint_progress(self.ckpt_dir))
+            lambda: run_progress(self.ckpt_dir, self.peer_root))
         self._sleep = sleep
         # elastic restarts: 0 = topology checking off; > 0 = the process
         # count the next child launch runs under, compared against the
@@ -320,6 +365,9 @@ class Supervisor:
         self._install_handlers()
         no_progress = 0
         self._log(f"supervising: {' '.join(map(str, self.child_argv))}")
+        if self.peer_root:
+            self._log(f"peer-replication store at {self.peer_root}: its "
+                      f"frontier counts as checkpoint progress")
         while True:
             before = self._progress()
             self._check_topology()
@@ -420,7 +468,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         backoff_max_s=args.backoff_max_s,
         crash_loop_tolerance=args.crash_loop_tolerance,
         term_grace_s=args.term_grace_s,
-        expect_processes=args.expect_processes or expected_process_count())
+        expect_processes=args.expect_processes or expected_process_count(),
+        peer_root=peer_store_root(child, ckpt_dir))
     return sup.run()
 
 

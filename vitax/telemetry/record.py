@@ -4,8 +4,8 @@ One `record_step` per log step turns the loop's host-side measurements into a
 versioned, machine-readable record (schema 1):
 
     schema, time, step, epoch, step_in_epoch, loss, lr, grad_norm,
-    sec_per_iter, images_per_sec, tokens_per_sec, data_wait_s, mfu,
-    mem_used_bytes, mem_peak_bytes[, mem_limit_bytes]
+    sec_per_iter, images_per_sec, tokens_per_sec, data_wait_s, ckpt_stall_s,
+    mfu, mem_used_bytes, mem_peak_bytes[, mem_limit_bytes]
 
 MFU comes from the analytic FLOPs model (telemetry/flops.py) over the
 measured sec/iter — no device work, no tracing. `event()` appends
@@ -63,10 +63,14 @@ class Recorder:
     def record_step(self, *, step: int, epoch: int, step_in_epoch: int,
                     loss: float, lr: float, sec_per_iter: float,
                     data_wait_s: float, grad_norm: Optional[float] = None,
+                    ckpt_stall_s: float = 0.0,
                     ) -> dict:
-        """One record per log step. `sec_per_iter` / `data_wait_s` are the
-        per-step averages since the previous record; `step` is the global
-        optimizer-step count (monotonically increasing across epochs)."""
+        """One record per log step. `sec_per_iter` / `data_wait_s` /
+        `ckpt_stall_s` are the per-step averages since the previous record;
+        `step` is the global optimizer-step count (monotonically increasing
+        across epochs). `ckpt_stall_s` is the zero-stall snapshot pipeline's
+        staging time charged to the loop thread (vitax/checkpoint/
+        snapshot.py) — the acceptance pin keeps it ~0 on non-final saves."""
         record = {
             "schema": SCHEMA_VERSION,
             "time": time.time(),
@@ -81,6 +85,7 @@ class Recorder:
             "tokens_per_sec": (self.tokens_per_step / sec_per_iter
                                if sec_per_iter > 0 else 0.0),
             "data_wait_s": float(data_wait_s),
+            "ckpt_stall_s": float(ckpt_stall_s),
             "mfu": mfu(self.cfg, sec_per_iter, self.n_devices,
                        self.peak_tflops),
         }

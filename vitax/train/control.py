@@ -82,6 +82,19 @@ BIT_FAULT = 1 << 2
 BIT_PEER_LOST = 1 << 3
 _ALL_BITS = BIT_PREEMPT | BIT_ESCALATE | BIT_FAULT | BIT_PEER_LOST
 
+# OUT-OF-BAND bit: the peer-restore agreement (agree_peer_restore) folds it
+# through the same OR collective, but it is NOT part of the step-loop control
+# word — unpack_word still rejects it, so a version-skewed host that leaks
+# the restore-time fold into a step-time poll fails loudly instead of being
+# silently read as "no signal". Semantics: a RAISED bit VETOES the peer
+# restore (OR-folds agree on the raised case, so the veto wins).
+BIT_PEER_RESTORE = 1 << 4
+
+# Bound on the coordinated-exit barrier when NEITHER the watchdog NOR peer
+# liveness is running (ControlPlane.arm_exit_deadline): a peer that dies
+# mid-drain must not hang survivors forever, whatever the config.
+DEFAULT_EXIT_DEADLINE_S = 300.0
+
 # Default agreement cadence (steps). Bounds the extra exposure after a local
 # signal to min(sync_steps, rest of the epoch) steps of wall time — the epoch
 # boundary always syncs too. Hosts must use the SAME value (the word sync is
@@ -147,6 +160,30 @@ def unpack_word(word: int) -> Signals:
                    escalate=bool(word & BIT_ESCALATE),
                    fault=bool(word & BIT_FAULT),
                    peer_lost=bool(word & BIT_PEER_LOST))
+
+
+def agree_peer_restore(local_ok: bool, process_count: Optional[int] = None,
+                       collective: Optional[Callable[[int], int]] = None,
+                       ) -> bool:
+    """The all-hosts gate on entering the peer-restore path
+    (vitax/checkpoint/peer.py negotiate_restore): every host folds
+    BIT_PEER_RESTORE — RAISED means "I cannot restore from peers" — through
+    the same OR collective the control word uses, so one host whose shard
+    fetch failed vetoes the peer path for the whole pod and everyone drops
+    to the Orbax fallback together. Mixing one peer-restored host with
+    Orbax-restored peers would silently diverge the replicas; this fold is
+    the BIT_PEER_RESTORE seam the tentpole names. Single-process: the local
+    verdict is the agreement."""
+    if process_count is None:
+        import jax
+        process_count = jax.process_count()
+    if process_count <= 1:
+        return bool(local_ok)
+    if collective is None:
+        from vitax import distributed
+        collective = distributed.or_across_processes
+    agreed = int(collective(0 if local_ok else BIT_PEER_RESTORE))
+    return not (agreed & BIT_PEER_RESTORE)
 
 
 def coordination_client():
@@ -369,6 +406,41 @@ class ControlPlane:
     def _deadline_exit(self, peer: int) -> None:
         print(f"vitax.control: loop did not reach a step boundary within "
               f"the liveness deadline after losing peer {peer} — "
+              f"hard-exiting {EXIT_HANG} for the supervisor",
+              file=sys.stderr, flush=True)
+        hard_exit = self._hard_exit
+        if hard_exit is None:
+            import os
+            hard_exit = os._exit
+        hard_exit(EXIT_HANG)
+
+    def arm_exit_deadline(self, deadline_s: Optional[float] = None) -> None:
+        """Bound the coordinated-exit barrier. A peer that dies after
+        agreement but before the barrier wedges survivors in the drain
+        forever; this arms a hard deadline on THIS host's exit. Prefers the
+        watchdog's own deadline machinery when one is running (same knob the
+        emergency path re-arms); otherwise — watchdog off, liveness off, the
+        PR 10 gap — arms the plane's own timer with DEFAULT_EXIT_DEADLINE_S,
+        so the barrier is bounded under EVERY config. No-op single-host
+        (nothing to wait on) and idempotent (first armed timer wins)."""
+        if self.process_count <= 1:
+            return
+        if (self.watchdog is not None
+                and getattr(self.watchdog, "running", False)):
+            self.watchdog.arm_exit_deadline()
+            return
+        deadline = float(deadline_s) if deadline_s else DEFAULT_EXIT_DEADLINE_S
+        with self._lock:
+            if self._exit_timer is not None:
+                return
+            self._exit_timer = threading.Timer(
+                deadline, self._drain_deadline_exit, args=(deadline,))
+            self._exit_timer.daemon = True
+            self._exit_timer.start()
+
+    def _drain_deadline_exit(self, deadline: float) -> None:
+        print(f"vitax.control: coordinated-exit barrier did not complete "
+              f"within {deadline:.0f}s — a peer likely died mid-drain; "
               f"hard-exiting {EXIT_HANG} for the supervisor",
               file=sys.stderr, flush=True)
         hard_exit = self._hard_exit

@@ -86,6 +86,29 @@ def train(cfg: Config) -> TrainState:
 
     # --- model + optimizer, born sharded (reference :228-242) ---
     auto_resume = cfg.resume_epoch < 0
+    # zero-stall snapshot pipeline + peer replication (vitax/checkpoint/
+    # snapshot.py, peer.py): built BEFORE resume so the peer store can be
+    # negotiated as the restore source — restoring a lost host's shard from
+    # its surviving ring buddy reads nothing from shared storage
+    snap_pipe = replicator = peer_plan = None
+    orbax_found = 0
+    deferred_events = []  # (kind, payload) raised before the recorder exists
+    if cfg.zero_stall_ckpt or cfg.replicate_steps > 0:
+        from vitax.checkpoint.snapshot import SnapshotPipeline
+        snap_pipe = SnapshotPipeline()
+        master_print("zero-stall checkpointing: staging on the loop thread, "
+                     "serialize + write on a background worker")
+    if cfg.replicate_steps > 0:
+        from vitax.checkpoint import peer as peer_mod
+        from vitax.train.control import coordination_client
+        store = peer_mod.PeerStore(peer_mod.resolve_peer_dir(cfg))
+        replicator = peer_mod.PeerReplicator(
+            store, process_index=jax.process_index(),
+            process_count=jax.process_count(), client=coordination_client())
+        replicator.start_receiver()
+        master_print(f"peer replication: every {cfg.replicate_steps} steps "
+                     f"-> buddy host {replicator.buddy} (local store "
+                     f"{store.root}, guarding host {replicator.guard})")
     if auto_resume:  # auto-resume: latest COMMITTED checkpoint, if any
         from vitax.checkpoint.orbax_io import latest_epoch
         # process 0 picks, everyone adopts: a non-atomic shared-store view
@@ -95,6 +118,28 @@ def train(cfg: Config) -> TrainState:
         found = distributed.broadcast_from_process0(latest_epoch(cfg.ckpt_dir) or 0)
         cfg = dataclasses.replace(cfg, resume_epoch=found)
         master_print(f"auto-resume: {'epoch ' + str(found) if found else 'no checkpoint found, fresh start'}")
+        orbax_found = found
+        if replicator is not None:
+            # restore-from-peers preferred: the newest complete peer version
+            # that beats the Orbax frontier wins (agreed by ALL hosts via
+            # the BIT_PEER_RESTORE fold; survivors serve the lost host's
+            # shard over the KV seam during the negotiation)
+            from vitax.checkpoint.orbax_io import load_resume_step
+            frontier = ((0, 0) if not found else peer_mod.progress_key(
+                found, load_resume_step(cfg.ckpt_dir, found) or 0))
+            peer_plan = peer_mod.negotiate_restore(
+                replicator.store, process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                client=coordination_client(), orbax_frontier=frontier,
+                on_event=lambda kind, payload:
+                    deferred_events.append((kind, payload)))
+            if peer_plan is not None:
+                cfg = dataclasses.replace(cfg, resume_epoch=peer_plan.epoch)
+                master_print(
+                    f"peer restore agreed: version {list(peer_plan.version)}"
+                    f" is at least as fresh as the Orbax frontier "
+                    f"{list(frontier)} — restoring from peer shards, not "
+                    f"shared storage")
     # step-granular resume: a mid-epoch (preemption) checkpoint carries the
     # completed step count in a sidecar — continue INSIDE that epoch instead
     # of skipping its remainder (improves on the reference's epoch-granular
@@ -105,7 +150,16 @@ def train(cfg: Config) -> TrainState:
     resume_step = 0
     topology_change = None  # (from, to) process counts when they differ
     resume_rounded = False  # cursor invalidated -> re-enter the SAME epoch
-    if cfg.resume_epoch > 0:
+    if peer_plan is not None:
+        # the peer meta is sidecar-shaped: the same elastic planner decides
+        # the re-entry step (every host reads identical agreed-version meta)
+        from vitax.train.control import elastic_resume_plan
+        plan = elastic_resume_plan(peer_plan.meta, jax.process_count())
+        resume_step = plan.resume_step
+        topology_change = ((plan.from_processes, jax.process_count())
+                           if plan.topology_changed else None)
+        resume_rounded = plan.epoch_rounded
+    elif cfg.resume_epoch > 0:
         resume_step, topology_change, resume_rounded = _elastic_resume(
             cfg, cfg.resume_epoch)
     model = build_model(cfg, attention_impl=attention_impl,
@@ -125,8 +179,25 @@ def train(cfg: Config) -> TrainState:
     state, state_specs, _ = make_train_state(
         cfg, model, tx, mesh, jax.random.key(cfg.seed),
         materialize=cfg.resume_epoch <= 0)
+    restore_info = None  # {"path": "peer"|"orbax", "epoch": N} for telemetry
+    from vitax.checkpoint.orbax_io import restore_read_count
+    reads_before_restore = restore_read_count()  # delta = THIS run's reads
     if cfg.resume_epoch > 0:
-        if auto_resume:
+        if peer_plan is not None:
+            # peer shards first; a checksum/coverage failure falls back
+            # LOUDLY to the last committed Orbax epoch (restore_info tells
+            # us which path actually won)
+            state, restore_info = peer_mod.restore_state_preferring_peers(
+                replicator.store, peer_plan, cfg.ckpt_dir, orbax_found,
+                state, on_event=lambda kind, payload:
+                    deferred_events.append((kind, payload)))
+            if restore_info["path"] == "orbax":
+                if restore_info["epoch"] != cfg.resume_epoch:
+                    cfg = dataclasses.replace(
+                        cfg, resume_epoch=restore_info["epoch"])
+                resume_step, topology_change, resume_rounded = (
+                    _elastic_resume(cfg, cfg.resume_epoch))
+        elif auto_resume:
             # an auto-resume must survive one bad checkpoint: fall back to
             # the previous committed epoch (loudly) instead of wedging
             state, restored = restore_state_with_fallback(
@@ -135,8 +206,10 @@ def train(cfg: Config) -> TrainState:
                 cfg = dataclasses.replace(cfg, resume_epoch=restored)
                 resume_step, topology_change, resume_rounded = (
                     _elastic_resume(cfg, restored))
+            restore_info = {"path": "orbax", "epoch": cfg.resume_epoch}
         else:  # an explicit --resume_epoch N must mean N — fail hard
             state = restore_state(cfg.ckpt_dir, cfg.resume_epoch, state)
+            restore_info = {"path": "orbax", "epoch": cfg.resume_epoch}
     distributed.barrier("loaded model")
     master_print(f"\n=== model ===\n{model}\n")
     master_print(f"global parameter num: {count_params(state.params)}")
@@ -184,6 +257,20 @@ def train(cfg: Config) -> TrainState:
         if fault_plan is not None:  # fired faults become kind:"fault" events
             faults.set_reporter(
                 lambda payload: recorder.event("fault", **payload))
+        for kind, payload in deferred_events:
+            recorder.event(kind, **payload)  # pre-recorder restore events
+        if restore_info is not None:
+            # which restore path actually won, plus the shared-storage read
+            # counter — the peer-restore drill asserts path=="peer" with
+            # orbax_reads == 0 (zero checkpoint state read from storage)
+            recorder.event("restore", path=restore_info["path"],
+                           epoch=int(restore_info["epoch"]),
+                           resume_step=int(resume_step),
+                           orbax_reads=restore_read_count()
+                           - reads_before_restore)
+    if replicator is not None and recorder is not None:
+        replicator.on_event = (lambda kind, payload:
+                               recorder.event(kind, **payload))
     watchdog = None
     if cfg.hang_timeout_s > 0:
         on_fire = ((lambda payload: recorder.event("hang", **payload))
@@ -253,7 +340,8 @@ def train(cfg: Config) -> TrainState:
             cfg, state, train_step, train_loader, val_loader, eval_step,
             schedule, smoothed_loss, smoothed_time, prof,
             resume_step=resume_step, resume_rounded=resume_rounded,
-            recorder=recorder, watchdog=watchdog, control=control)
+            recorder=recorder, watchdog=watchdog, control=control,
+            snap_pipe=snap_pipe, replicator=replicator)
     except Exception as e:  # noqa: BLE001 — classify, then exit coordinated or re-raise
         # A dead peer shows up two ways: ICI collectives BLOCK on it (the
         # liveness deadline timer bounds that), host-plane transports like
@@ -281,6 +369,10 @@ def train(cfg: Config) -> TrainState:
             watchdog.stop()  # before the loaders: their drain must not fire it
         train_loader.close()
         val_loader.close()
+        if replicator is not None:
+            replicator.stop()  # the receiver thread, not the store
+        if snap_pipe is not None:
+            snap_pipe.close()  # drain queued persist/replicate jobs
         from vitax.checkpoint.orbax_io import wait_until_finished
         wait_until_finished()  # drain any in-flight async save before exit
         if recorder is not None:
@@ -359,10 +451,32 @@ def _elastic_resume(cfg, epoch: int):
     return step, ((prev, jax.process_count()) if prev else None), rounded
 
 
+def _save_ckpt(cfg, state, epoch, *, wait, step_in_epoch=None,
+               stream_cursor=None, snap_pipe=None, replicator=None):
+    """Route a checkpoint save through the zero-stall pipeline when one is
+    active — ALL saves must: Orbax's async checkpointer is a per-process
+    singleton, and a direct save from the loop thread would race the
+    pipeline's worker. wait=True keeps its meaning (drain before return —
+    final/emergency semantics). Saves under an active replication window
+    record the window in the resume sidecar and refresh the peer store."""
+    extra = ({"replicate_steps": cfg.replicate_steps}
+             if cfg.replicate_steps > 0 else None)
+    if snap_pipe is not None:
+        snap_pipe.submit(state, epoch=epoch, step_in_epoch=step_in_epoch or 0,
+                         stream_cursor=stream_cursor, persist_to=cfg.ckpt_dir,
+                         keep=cfg.keep_checkpoints, extra_meta=extra,
+                         replicator=replicator, wait=wait)
+    else:
+        save_state(cfg.ckpt_dir, epoch, state, wait=wait,
+                   step_in_epoch=step_in_epoch, stream_cursor=stream_cursor,
+                   keep=cfg.keep_checkpoints, extra_meta=extra)
+
+
 def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 schedule, smoothed_loss, smoothed_time, prof,
                 resume_step: int = 0, resume_rounded: bool = False,
-                recorder=None, watchdog=None, control=None):
+                recorder=None, watchdog=None, control=None,
+                snap_pipe=None, replicator=None):
     if control is None:  # direct callers (tests): a local, collective-free plane
         control = ControlPlane(sync_steps=cfg.control_sync_steps,
                                watchdog=watchdog)
@@ -465,8 +579,21 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                         sec_per_iter=smoothed_time.avg,
                         data_wait_s=(train_loader.consume_wait_s()
                                      / max(steps_since_record, 1)),
+                        ckpt_stall_s=((snap_pipe.consume_stall_s()
+                                       / max(steps_since_record, 1))
+                                      if snap_pipe is not None else 0.0),
                         grad_norm=float(jax.device_get(metrics["grad_norm"])))
                 steps_since_record = 0
+            if (replicator is not None and snap_pipe is not None
+                    and (step + 1) % cfg.replicate_steps == 0):
+                # replication window: stage this host's shard (the only part
+                # on the loop thread — charged to ckpt_stall_s) and mirror
+                # it to the ring buddy from the pipeline worker
+                snap_pipe.submit(
+                    state, epoch=epoch, step_in_epoch=step + 1,
+                    stream_cursor=_stream_cursor(train_loader, epoch,
+                                                 step + 1),
+                    replicator=replicator)
             # step-boundary control poll (vitax/train/control.py): folds the
             # watchdog's escalation flag, the SIGTERM flag, and fault/peer
             # bits into one word — agreed across hosts on the sync cadence,
@@ -488,10 +615,13 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                              f"and exiting with code {EXIT_HANG} "
                              f"(agreed signals: {sig.describe()})")
                 jax.device_get(metrics["loss"])  # fence: step must be done
-                save_state(cfg.ckpt_dir, epoch, state, wait=True,
+                _save_ckpt(cfg, state, epoch, wait=True,
                            step_in_epoch=step + 1,
                            stream_cursor=_stream_cursor(train_loader, epoch,
-                                                        step + 1))
+                                                        step + 1),
+                           snap_pipe=snap_pipe, replicator=replicator)
+                control.arm_exit_deadline()  # bound the barrier: a peer
+                # dead mid-drain must not wedge survivors forever
                 distributed.barrier("coordinated emergency exit")
                 raise SystemExit(EXIT_HANG)
             if sig.preempt:
@@ -503,16 +633,17 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 master_print(f"SIGTERM received: saving preemption checkpoint "
                              f"at epoch {epoch} (step {step + 1}) and exiting")
                 jax.device_get(metrics["loss"])  # fence: step must be done
-                save_state(cfg.ckpt_dir, epoch, state, wait=True,
+                _save_ckpt(cfg, state, epoch, wait=True,
                            step_in_epoch=step + 1,
                            stream_cursor=_stream_cursor(train_loader, epoch,
-                                                        step + 1))
+                                                        step + 1),
+                           snap_pipe=snap_pipe, replicator=replicator)
                 # bounded: a peer that died mid-save must not wedge this
-                # host in the barrier forever — arm the watchdog's hard
-                # deadline (works under any --hang_action; without a
-                # watchdog, --hang_timeout_s 0, the barrier is unbounded)
-                if watchdog is not None and watchdog.running:
-                    watchdog.arm_exit_deadline()
+                # host in the barrier forever — the plane prefers the
+                # watchdog's hard deadline when one runs and otherwise arms
+                # its own DEFAULT_EXIT_DEADLINE_S timer, so the barrier is
+                # bounded under EVERY config (the PR 10 gap, closed)
+                control.arm_exit_deadline()
                 distributed.barrier("coordinated preemption exit")
                 return state
             if cfg.max_steps and total_steps >= cfg.max_steps:
@@ -532,23 +663,28 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
             master_print(f"watchdog escalation: saving emergency checkpoint "
                          f"after epoch {epoch} and exiting with code "
                          f"{EXIT_HANG} (agreed signals: {sig.describe()})")
-            save_state(cfg.ckpt_dir, epoch, state, wait=True)
+            _save_ckpt(cfg, state, epoch, wait=True,
+                       snap_pipe=snap_pipe, replicator=replicator)
+            control.arm_exit_deadline()  # bound the barrier (see above)
             distributed.barrier("coordinated emergency exit")
             raise SystemExit(EXIT_HANG)
         if sig.preempt:
             master_print(f"SIGTERM received: saving preemption checkpoint "
                          f"after epoch {epoch} and exiting")
-            save_state(cfg.ckpt_dir, epoch, state, wait=True)
-            if watchdog is not None and watchdog.running:
-                watchdog.arm_exit_deadline()  # bound the barrier (see above)
+            _save_ckpt(cfg, state, epoch, wait=True,
+                       snap_pipe=snap_pipe, replicator=replicator)
+            control.arm_exit_deadline()  # bound the barrier (see above)
             distributed.barrier("coordinated preemption exit")
             return state
 
         if epoch % cfg.ckpt_epoch_interval == 0 or epoch == cfg.num_epochs:
             # async: the device->host snapshot happens before return, the write
             # commits in background while the next epoch trains; the final save
-            # waits so training never exits with an uncommitted checkpoint
-            save_state(cfg.ckpt_dir, epoch, state, wait=epoch == cfg.num_epochs)
+            # waits so training never exits with an uncommitted checkpoint.
+            # Under --zero_stall_ckpt even the snapshot leaves the loop thread
+            # after a staged memcpy (vitax/checkpoint/snapshot.py).
+            _save_ckpt(cfg, state, epoch, wait=epoch == cfg.num_epochs,
+                       snap_pipe=snap_pipe, replicator=replicator)
         if epoch % cfg.test_epoch_interval == 0 or epoch == cfg.num_epochs:
             top1, top5, _, _ = eval_on_val(cfg, val_loader, eval_step, state,
                                            recorder=recorder, epoch=epoch)
