@@ -17,7 +17,9 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
+import zlib
 
 import jax
 import numpy as np
@@ -301,6 +303,220 @@ def test_restore_falls_back_to_orbax_on_bad_peer(devices8, tmp_path):
             store, plan, cfg.ckpt_dir, 0, abstract_of(state, mesh, sspecs))
 
 
+# --- multi-host negotiation (fake KV + OR-fold, two threads) -----------------
+
+class _FakeKV:
+    """In-memory stand-in for the coordination-service KV client."""
+
+    def __init__(self):
+        self._d = {}
+        self._cond = threading.Condition()
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        with self._cond:
+            self._d[key] = value
+            self._cond.notify_all()
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cond:
+            while key not in self._d:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(key)
+                self._cond.wait(remaining)
+            return self._d[key]
+
+
+class _OrFold:
+    """A per-round OR collective across n threads (the BIT_PEER_RESTORE
+    agreement fold) — every participant blocks until all n contributed."""
+
+    def __init__(self, n):
+        self.n = n
+        self._cond = threading.Condition()
+        self._words = []
+        self._done = []
+
+    def __call__(self, word):
+        with self._cond:
+            rnd = len(self._done)
+            self._words.append(int(word))
+            if len(self._words) == self.n:
+                folded = 0
+                for w in self._words:
+                    folded |= w
+                self._done.append(folded)
+                self._words = []
+                self._cond.notify_all()
+            else:
+                if not self._cond.wait_for(lambda: len(self._done) > rnd,
+                                           timeout=30):
+                    raise TimeoutError("OR-fold never completed")
+            return self._done[rnd]
+
+
+def _put_fake_shard(store, src, version, corrupt=False):
+    """A minimal valid peer blob (negotiation only reads meta + crc32)."""
+    payload = json.dumps({"src": src, "v": list(version)}).encode() * 7
+    store.put({"version": list(version), "src": int(src),
+               "step_in_epoch": int(version[1]),
+               "process_count": int(version[2]), "leaves": [],
+               "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+               "nbytes": len(payload)}, payload)
+    if corrupt:
+        blob = os.path.join(store.root, f"host_{src}", "shard.npz")
+        raw = bytearray(open(blob, "rb").read())
+        raw[0] ^= 0xFF
+        with open(blob, "wb") as f:
+            f.write(bytes(raw))
+
+
+def _negotiate_two(stores, timeout_s=5.0):
+    kv, fold = _FakeKV(), _OrFold(2)
+    results, errors = [None, None], [None, None]
+
+    def run(pid):
+        try:
+            results[pid] = peer.negotiate_restore(
+                stores[pid], process_index=pid, process_count=2,
+                client=kv, collective=fold, timeout_s=timeout_s)
+        except BaseException as e:  # noqa: BLE001 — surfaced by the assert below
+            errors[pid] = e
+
+    threads = [threading.Thread(target=run, args=(pid,)) for pid in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert errors == [None, None], errors
+    return results
+
+
+def test_negotiate_verifies_held_shards_and_refetches(tmp_path):
+    """A host whose LOCALLY HELD copy of the agreed version is corrupt must
+    detect it during negotiation and replace it from the serving holder —
+    not sail through the agreement and then strand itself alone on the
+    Orbax fallback at restore time (the divergent-replica hazard the
+    BIT_PEER_RESTORE gate exists to prevent)."""
+    v = (1, 4, 2)
+    stores = [peer.PeerStore(str(tmp_path / "p0")),
+              peer.PeerStore(str(tmp_path / "p1"))]
+    _put_fake_shard(stores[0], 0, v)
+    _put_fake_shard(stores[0], 1, v)          # host 0 guards host 1 too
+    _put_fake_shard(stores[1], 1, v, corrupt=True)  # host 1's own copy rots
+
+    plans = _negotiate_two(stores)
+    assert all(p is not None and p.version == v for p in plans), plans
+    # the corrupt copy was REPLACED during negotiation: every shard in
+    # every store now load-verifies for the agreed version
+    for store in stores:
+        for src in (0, 1):
+            if src in store.holdings():
+                store.load(src, expect_version=v)
+    stores[1].load(1, expect_version=v)  # specifically the refetched one
+
+
+def test_negotiate_declines_together_when_sole_copy_corrupt(tmp_path):
+    """When the ONLY copy of a shard is corrupt, no host can serve it: both
+    hosts must decline the peer path together (None == Orbax fallback for
+    the whole pod), not split."""
+    v = (1, 4, 2)
+    stores = [peer.PeerStore(str(tmp_path / "p0")),
+              peer.PeerStore(str(tmp_path / "p1"))]
+    _put_fake_shard(stores[0], 0, v)
+    _put_fake_shard(stores[1], 1, v, corrupt=True)  # sole copy of shard 1
+    plans = _negotiate_two(stores, timeout_s=1.0)
+    assert plans == [None, None], plans
+
+
+def test_negotiate_counts_mixed_version_coverage(tmp_path):
+    """The common steady state: each host's self-spill is one replication
+    window ahead of the replica it mirrors for its guard. The newest
+    version IS fully covered across hosts — negotiation must find it
+    rather than flattening each host to a single version and declining."""
+    v_new, v_old = (1, 4, 2), (1, 2, 2)
+    stores = [peer.PeerStore(str(tmp_path / "p0")),
+              peer.PeerStore(str(tmp_path / "p1"))]
+    _put_fake_shard(stores[0], 0, v_new)  # fresh self-spill
+    _put_fake_shard(stores[0], 1, v_old)  # buddy replica lags one window
+    _put_fake_shard(stores[1], 1, v_new)
+    _put_fake_shard(stores[1], 0, v_old)
+
+    plans = _negotiate_two(stores)
+    assert all(p is not None and p.version == v_new for p in plans), plans
+    # both hosts completed their stores: every shard of v_new everywhere
+    for store in stores:
+        for src in (0, 1):
+            store.load(src, expect_version=v_new)
+
+
+def test_post_agreement_veto_drops_to_orbax(devices8, tmp_path):
+    """The second fold: even when THIS host's peer load succeeds, a peer's
+    post-agreement veto must drop it to the Orbax fallback with the pod —
+    and with no veto the peer path stands."""
+    cfg = tiny_cfg(ckpt_dir=str(tmp_path / "ckpt"))
+    mesh, state, sspecs = make_state(cfg)
+    save_state(cfg.ckpt_dir, 1, state, wait=True)
+    pipe = snapshot.SnapshotPipeline()
+    try:
+        snap = pipe.stage(state, epoch=1, step_in_epoch=2)
+        meta, payload = peer.pack_snapshot(snap, src=0)
+        snap.release()
+    finally:
+        pipe.close()
+    store = peer.PeerStore(str(tmp_path / "store"))
+    store.put(meta, payload)
+    plan = peer.negotiate_restore(store, process_index=0, process_count=1)
+    assert plan is not None
+
+    events = []
+    restored, info = peer.restore_state_preferring_peers(
+        store, plan, cfg.ckpt_dir, 1, abstract_of(state, mesh, sspecs),
+        on_event=lambda kind, payload: events.append((kind, payload)),
+        process_count=2, collective=lambda w: w | BIT_PEER_RESTORE)
+    assert info["path"] == "orbax" and info["epoch"] == 1
+    assert "fallback_from" in info
+    _leaves_equal(state, restored)
+    assert ("control", "peer_restore_failed") in [
+        (k, p.get("event")) for k, p in events]
+
+    restored2, info2 = peer.restore_state_preferring_peers(
+        store, plan, cfg.ckpt_dir, 1, abstract_of(state, mesh, sspecs),
+        process_count=2, collective=lambda w: w)
+    assert info2["path"] == "peer"
+    _leaves_equal(state, restored2)
+
+
+# --- rebuild HBM gate --------------------------------------------------------
+
+def test_rebuild_gates_on_hbm_headroom(devices8, monkeypatch):
+    """The persist path's transient second device copy must be refused —
+    loudly, with guidance — when device memory_stats say it cannot fit;
+    the escape hatch and the roomy case both proceed."""
+    cfg = tiny_cfg()
+    _, state, _ = make_state(cfg)
+    pipe = snapshot.SnapshotPipeline()
+    try:
+        snap = pipe.stage(state, epoch=1)
+        monkeypatch.setenv("VITAX_SNAPSHOT_HBM_WAIT_S", "0")
+        monkeypatch.setattr(
+            snapshot, "_device_memory_stats",
+            lambda device: {"bytes_limit": 1024, "bytes_in_use": 1024})
+        with pytest.raises(RuntimeError, match="HBM"):
+            snap.rebuild()
+        monkeypatch.setenv("VITAX_SNAPSHOT_HBM_CHECK", "0")
+        _leaves_equal(state, snap.rebuild())
+        monkeypatch.delenv("VITAX_SNAPSHOT_HBM_CHECK")
+        monkeypatch.setattr(
+            snapshot, "_device_memory_stats",
+            lambda device: {"bytes_limit": 1 << 40, "bytes_in_use": 0})
+        _leaves_equal(state, snap.rebuild())
+        snap.release()
+    finally:
+        pipe.close()
+
+
 # --- checkpoint GC (--keep_checkpoints) --------------------------------------
 
 def _fake_committed(ckpt_dir, epoch, sidecar=False):
@@ -495,6 +711,30 @@ def test_supervisor_counts_peer_progress(tmp_path):
                            str(ckpt)) == ""
     assert peer_store_root(["run.py", "--replicate_steps=2"],
                            str(ckpt)).endswith("peerstore")
+
+
+def test_run_progress_normalizes_boundary_saves(tmp_path):
+    """A peer BOUNDARY version (e, 0) means epoch e is COMPLETE: it must
+    outrank a stale mid-epoch Orbax frontier (e, s) — both sides of the
+    crash-loop progress check compare in progress_key space."""
+    from vitax.supervise import run_progress
+    ckpt = tmp_path / "ckpt"
+    _fake_committed(ckpt, 3)
+    with open(epoch_ckpt_path(str(ckpt), 3) + ".resume.json", "w") as f:
+        json.dump({"step_in_epoch": 5}, f)  # mid-epoch-3 Orbax frontier
+    root = tmp_path / "peers"
+    host = root / "p1" / "host_1"
+    os.makedirs(host)
+    with open(host / "meta.json", "w") as f:
+        json.dump({"version": [3, 0, 2], "src": 1}, f)  # epoch 3 COMPLETE
+
+    assert peer.store_frontier(str(root)) == (4, 0)
+    assert run_progress(str(ckpt)) == (3, 5)
+    # the epoch-completing peer version wins over the mid-epoch frontier
+    assert run_progress(str(ckpt), str(root)) == (4, 0)
+    # an empty store still reads as no progress, not as (1, 0)
+    assert run_progress(str(tmp_path / "none"), str(tmp_path / "no_peers")) \
+        == (0, 0)
 
 
 # --- loop integration --------------------------------------------------------

@@ -23,12 +23,20 @@ host.
 
 Restore negotiation (`negotiate_restore`): every host publishes what its
 store holds, process 0 picks the newest version whose shards cover the full
-topology AND beat the Orbax frontier, holders serve any shard a host lacks
-(chunked over the same KV seam), and the final all-hosts gate is a
-`BIT_PEER_RESTORE` agreement fold (vitax/train/control.py
+topology AND beat the Orbax frontier (counting every (src, version) pair a
+host reported — one host routinely holds DIFFERENT versions of different
+srcs, its fresh self-spill plus a buddy replica one window behind), holders
+serve any shard a host lacks (chunked over the same KV seam), and every
+host checksum-verifies EVERY copy it already holds — a corrupt local
+replica is replaced from the serving holder, or vetoes. The all-hosts gate
+is a `BIT_PEER_RESTORE` agreement fold (vitax/train/control.py
 agree_peer_restore) — survivors explicitly agree to serve/accept shards
 before anyone re-enters the step, so a host whose fetch failed can veto the
-peer path and drop the whole pod to the Orbax fallback coherently.
+peer path and drop the whole pod to the Orbax fallback coherently. The
+fold runs AGAIN after the actual load (restore_state_preferring_peers), so
+even a failure that only surfaces at restore time moves the whole pod to
+Orbax together — never one host on an older epoch while its peers enter
+the step on the newer peer version.
 
 Corruption: every blob carries a crc32; `PeerStore.load` verifies it (and
 fires the `peer_restore` fault site so drills can inject exactly this) and
@@ -237,8 +245,12 @@ class PeerStore:
 
 
 def store_frontier(root: str) -> Tuple[int, int]:
-    """(epoch, step) progress frontier across every per-process store under
-    `root` — the supervisor folds this into its crash-loop progress check so
+    """NORMALIZED (progress_key) (epoch, step) progress frontier across
+    every per-process store under `root`, (0, 0) when empty — a boundary
+    version (e, 0) counts as (e + 1, 0), so epoch-completing progress made
+    only via peer replication is never outranked by a stale mid-epoch
+    version. The supervisor folds this into its crash-loop progress check
+    (run_progress, which normalizes the Orbax side the same way) so
     peer-replicated progress counts even when no Orbax commit advanced."""
     best = (0, 0)
     if not os.path.isdir(root):
@@ -249,7 +261,8 @@ def store_frontier(root: str) -> Tuple[int, int]:
             continue
         for src, meta in PeerStore(d).holdings().items():
             v = meta.get("version") or [0, 0, 0]
-            best = max(best, (int(v[0]), int(v[1])))
+            if int(v[0]) or int(v[1]):
+                best = max(best, progress_key(v[0], v[1]))
     return best
 
 
@@ -457,25 +470,32 @@ def negotiate_restore(store: PeerStore, *, process_index: int,
                          json.dumps(mine), allow_overwrite=True)
     # 2. process 0 reads all holdings, picks the candidate, broadcasts it
     if process_index == 0:
-        merged: Dict[int, dict] = {}
-        per_host: Dict[int, dict] = {}
+        per_host: Dict[int, Dict[int, Tuple]] = {}
         for pid in range(process_count):
             try:
                 raw = client.blocking_key_value_get(
                     f"{RESTORE_KEY_PREFIX}/holdings/{pid}", deadline_ms)
-                per_host[pid] = {int(s): v for s, v in json.loads(raw).items()}
+                per_host[pid] = {int(s): tuple(int(x) for x in v)
+                                 for s, v in json.loads(raw).items()
+                                 if len(v) == 3}
             except Exception:  # noqa: BLE001 — a host with no store publishes nothing useful
                 per_host[pid] = {}
-        for pid, held in per_host.items():
-            for src, v in held.items():
-                merged[src] = {"src": src, "version": v}
-        v = best(_complete_versions(merged))
+        # count EVERY (src, version) pair toward coverage: one host
+        # routinely holds different versions of different srcs (its own
+        # fresh self-spill plus a buddy replica one replication window
+        # behind) — flattening to one version per src would mix versions
+        # and silently decline a newest version that IS fully covered
+        coverage: Dict[Tuple, set] = {}
+        for held in per_host.values():
+            for src, ver in held.items():
+                coverage.setdefault(ver, set()).add(src)
+        v = best([ver for ver, srcs in coverage.items()
+                  if srcs >= set(range(ver[2]))])
         plan_wire = {"version": list(v) if v else None, "holders": {
             str(src): min(pid for pid, held in per_host.items()
-                          if tuple(held.get(src, ())) == v)
+                          if held.get(src) == v)
             for src in (range(v[2]) if v else ())
-            if any(tuple(held.get(src, ())) == v
-                   for held in per_host.values())}}
+            if any(held.get(src) == v for held in per_host.values())}}
         client.key_value_set(f"{RESTORE_KEY_PREFIX}/plan",
                              json.dumps(plan_wire), allow_overwrite=True)
     try:
@@ -490,31 +510,45 @@ def negotiate_restore(store: PeerStore, *, process_index: int,
     version = tuple(int(x) for x in version)
     holders = {int(s): int(p)
                for s, p in (plan_wire.get("holders") or {}).items()}
-    # 3. serve what this host holds and others may lack; fetch what it lacks
+    # 3. checksum-verify EVERY locally held copy of the candidate — a
+    #    corrupt replica must surface NOW, while the serving holder can
+    #    still replace it; discovered only at restore time it would strand
+    #    this host alone on the Orbax fallback while its peers enter the
+    #    step on the peer version. Then serve what this host holds and
+    #    others may lack, and fetch what it lacks (or cannot read).
     local_ok = True
     for src in range(version[2]):
-        have = tuple(holdings.get(src, {}).get("version", ())) == version
-        if have and holders.get(src) == process_index:
+        held = tuple(holdings.get(src, {}).get("version", ())) == version
+        serving = holders.get(src) == process_index
+        verified = False
+        if held:
             try:
                 meta, payload = store.load(src, expect_version=version)
-                _publish_blob(client, f"{RESTORE_KEY_PREFIX}/data/{src}",
-                              meta, payload, gen=1)
+                verified = True
+                if serving:
+                    _publish_blob(client, f"{RESTORE_KEY_PREFIX}/data/{src}",
+                                  meta, payload, gen=1)
             except PeerRestoreError as e:
-                print(f"vitax.peer: cannot serve shard {src}: {e}",
-                      file=sys.stderr, flush=True)
-                local_ok = False
-        elif not have:
-            try:
-                got = _wait_blob(client, f"{RESTORE_KEY_PREFIX}/data/{src}",
-                                 timeout_s)
-                if got is None:
-                    raise PeerRestoreError(
-                        f"shard {src} not served within {timeout_s:g}s")
-                store.put(*got)
-            except PeerRestoreError as e:
-                print(f"vitax.peer: fetch of shard {src} failed: {e}",
-                      file=sys.stderr, flush=True)
-                local_ok = False
+                print(f"vitax.peer: locally held shard {src} failed "
+                      f"verification: {e}", file=sys.stderr, flush=True)
+        if verified:
+            continue
+        if serving:
+            # the designated server cannot read its own copy: no other
+            # host will publish this shard — veto the peer path
+            local_ok = False
+            continue
+        try:
+            got = _wait_blob(client, f"{RESTORE_KEY_PREFIX}/data/{src}",
+                             timeout_s)
+            if got is None:
+                raise PeerRestoreError(
+                    f"shard {src} not served within {timeout_s:g}s")
+            store.put(*got)
+        except PeerRestoreError as e:
+            print(f"vitax.peer: fetch of shard {src} failed: {e}",
+                  file=sys.stderr, flush=True)
+            local_ok = False
     # 4. the all-hosts gate: everyone enters the peer path, or no one does
     agreed = _agree(local_ok, process_count, collective)
     if on_event is not None:
@@ -606,40 +640,58 @@ def restore_from_store(store: PeerStore, plan: RestorePlan,
 def restore_state_preferring_peers(store: PeerStore, plan: RestorePlan,
                                    ckpt_dir: str, orbax_epoch: int,
                                    abstract_state: PyTree,
-                                   on_event=None) -> Tuple[PyTree, dict]:
+                                   on_event=None,
+                                   process_count: Optional[int] = None,
+                                   collective=None) -> Tuple[PyTree, dict]:
     """The loop's restore entry when a peer plan was agreed: peer shards
-    first; on ANY PeerRestoreError (checksum, missing file, bad coverage)
-    fall back LOUDLY to the last committed Orbax epoch through
-    restore_state_with_fallback. Returns (state, info) where info carries
-    {"path": "peer"|"orbax", "epoch": restored-epoch, ...} for the loop's
-    restore telemetry event."""
+    first, then a SECOND BIT_PEER_RESTORE agreement fold on the load
+    outcome — the negotiation verified what each host held, but the load is
+    the final word, and a failure that only surfaces here (a replica gone
+    bad between the agreement and the read) must drop the WHOLE pod to the
+    Orbax fallback together, never one host alone onto an older epoch while
+    its peers enter the step on the peer version. On any PeerRestoreError
+    or a peer's veto, fall back LOUDLY to the last committed Orbax epoch
+    through restore_state_with_fallback. Returns (state, info) where info
+    carries {"path": "peer"|"orbax", "epoch": restored-epoch, ...} for the
+    loop's restore telemetry event. `process_count`/`collective` default to
+    the live JAX topology (agree_peer_restore)."""
+    state, err = None, None
     try:
         state = restore_from_store(store, plan, abstract_state)
+    except PeerRestoreError as e:
+        err = e
+    from vitax.train.control import agree_peer_restore
+    agreed = agree_peer_restore(err is None, process_count=process_count,
+                                collective=collective)
+    if agreed:
         master_print(
             f"restored from PEER shards: version {list(plan.version)} "
             f"({plan.version[2]} replica(s) from {store.root}; zero "
             f"shared-storage checkpoint reads)")
         return state, {"path": "peer", "epoch": plan.epoch,
                        "step_in_epoch": int(plan.version[1])}
-    except PeerRestoreError as e:
-        print(f"vitax.peer: PEER RESTORE FAILED ({e}); falling back to the "
-              f"last committed Orbax epoch", file=sys.stderr, flush=True)
-        if on_event is not None:
-            try:
-                on_event("control", {"event": "peer_restore_failed",
-                                     "version": list(plan.version),
-                                     "error": str(e),
-                                     "fallback_epoch": int(orbax_epoch)})
-            except Exception as sink_err:  # noqa: BLE001 — observability must not mask the fallback
-                print(f"vitax.peer: restore event sink failed "
-                      f"({type(sink_err).__name__}: {sink_err})",
-                      file=sys.stderr, flush=True)
-        if orbax_epoch <= 0:
-            raise RuntimeError(
-                "peer restore failed and no committed Orbax checkpoint "
-                "exists to fall back to") from e
-        from vitax.checkpoint.orbax_io import restore_state_with_fallback
-        state, restored = restore_state_with_fallback(
-            ckpt_dir, orbax_epoch, abstract_state)
-        return state, {"path": "orbax", "epoch": int(restored),
-                       "fallback_from": str(e)}
+    if err is None:
+        err = PeerRestoreError(
+            "a peer host vetoed after the post-agreement shard load — "
+            "dropping to the Orbax fallback with the pod")
+    print(f"vitax.peer: PEER RESTORE FAILED ({err}); falling back to the "
+          f"last committed Orbax epoch", file=sys.stderr, flush=True)
+    if on_event is not None:
+        try:
+            on_event("control", {"event": "peer_restore_failed",
+                                 "version": list(plan.version),
+                                 "error": str(err),
+                                 "fallback_epoch": int(orbax_epoch)})
+        except Exception as sink_err:  # noqa: BLE001 — observability must not mask the fallback
+            print(f"vitax.peer: restore event sink failed "
+                  f"({type(sink_err).__name__}: {sink_err})",
+                  file=sys.stderr, flush=True)
+    if orbax_epoch <= 0:
+        raise RuntimeError(
+            "peer restore failed and no committed Orbax checkpoint "
+            "exists to fall back to") from err
+    from vitax.checkpoint.orbax_io import restore_state_with_fallback
+    state, restored = restore_state_with_fallback(
+        ckpt_dir, orbax_epoch, abstract_state)
+    return state, {"path": "orbax", "epoch": int(restored),
+                   "fallback_from": str(err)}

@@ -27,6 +27,17 @@ else:
              concurrent save() calls race its internal state, so when the
              pipeline is on, ALL saves route through it — including the
              wait=True emergency/final paths, which just drain the queue.
+             CAVEAT — a persist job rebuilds a TRANSIENT SECOND device copy
+             of this host's state shard (device_put of the staged buffers)
+             while the next training steps are running; the pre-pipeline
+             path saved the live arrays with no extra device allocation.
+             rebuild() gates that allocation on available HBM headroom
+             (device memory_stats, where the backend exposes them) and
+             fails the job with a clear error rather than risk an
+             allocator OOM or a defragmentation stall in the middle of a
+             dispatched step. VITAX_SNAPSHOT_HBM_CHECK=0 disables the
+             gate; VITAX_SNAPSHOT_HBM_WAIT_S (default 10) bounds how long
+             the job re-polls for headroom before giving up.
 
 Staging buffers live in a small free-list (at most `max_buffer_sets`,
 default 2): steady state allocates nothing and touches the same pages every
@@ -60,6 +71,16 @@ def _index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
     return tuple((int(s.start or 0),
                   int(s.stop if s.stop is not None else dim))
                  for s, dim in zip(index, shape))
+
+
+def _device_memory_stats(device) -> Optional[dict]:
+    """device.memory_stats() as a dict, or None when the backend exposes
+    none (CPU, some PJRT plugins). A seam so tests can fake HBM pressure."""
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — stats are best-effort, never fatal
+        return None
+    return stats if isinstance(stats, dict) else None
 
 
 def _path_str(key_path) -> str:
@@ -137,7 +158,15 @@ class HostSnapshot:
         """Global device arrays from the staged host copies — what the
         persist job hands Orbax. Each host contributes exactly its
         addressable shards (device_put per placement), so the write path is
-        identical to saving the live state."""
+        identical to saving the live state.
+
+        This allocates a TRANSIENT SECOND device copy of this host's state
+        shard while the next training steps run (it is freed once Orbax's
+        own host snapshot is taken and the persist job drops the tree), so
+        the allocation is gated on available HBM headroom first — a persist
+        job failing with a clear error beats an allocator OOM or a
+        defragmentation stall hitting a dispatched step."""
+        self._gate_on_hbm()
         leaves = []
         for i, spec in enumerate(self.specs):
             bufs = self.buffers(i)
@@ -146,6 +175,53 @@ class HostSnapshot:
             leaves.append(jax.make_array_from_single_device_arrays(
                 spec.shape, spec.sharding, arrays))
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def _transient_device_bytes(self) -> dict:
+        """{device: bytes rebuild() will place on it} — the extra HBM the
+        persist path borrows on top of the live training state."""
+        per_dev: dict = {}
+        for i, spec in enumerate(self.specs):
+            bufs = self.buffers(i)
+            for device, slot in spec.placements:
+                per_dev[device] = per_dev.get(device, 0) + bufs[slot].nbytes
+        return per_dev
+
+    def _gate_on_hbm(self) -> None:
+        """Refuse rebuild()'s device allocation when it clearly cannot fit.
+        Best-effort: backends without memory_stats (CPU) skip the check;
+        headroom is re-polled for a short window first (a running step's
+        temporaries come and go). VITAX_SNAPSHOT_HBM_CHECK=0 forces the
+        attempt anyway; VITAX_SNAPSHOT_HBM_WAIT_S bounds the re-poll."""
+        import os
+        if os.environ.get("VITAX_SNAPSHOT_HBM_CHECK", "1") == "0":
+            return
+        deadline = time.monotonic() + float(
+            os.environ.get("VITAX_SNAPSHOT_HBM_WAIT_S", 10.0))
+        while True:
+            blocked = None
+            for device, incoming in self._transient_device_bytes().items():
+                stats = _device_memory_stats(device)
+                if not stats:
+                    continue
+                limit = int(stats.get("bytes_limit") or 0)
+                free = limit - int(stats.get("bytes_in_use") or 0)
+                if limit and incoming > free:
+                    blocked = (device, incoming, free, limit)
+                    break
+            if blocked is None:
+                return
+            if time.monotonic() >= deadline:
+                device, incoming, free, limit = blocked
+                raise RuntimeError(
+                    f"snapshot persist needs a transient second copy of "
+                    f"this host's state shard on {device} "
+                    f"({incoming / 2**20:.0f} MiB) but only "
+                    f"{max(free, 0) / 2**20:.0f} of {limit / 2**20:.0f} MiB "
+                    f"HBM are free — refusing the allocation (an OOM or "
+                    f"defrag stall would hit the running step). Free HBM, "
+                    f"lower the save/replication cadence, or set "
+                    f"VITAX_SNAPSHOT_HBM_CHECK=0 to force the attempt.")
+            time.sleep(0.2)
 
 
 class SnapshotPipeline:

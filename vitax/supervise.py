@@ -176,10 +176,17 @@ def run_progress(ckpt_dir: str, peer_root: str = "") -> Tuple[int, int]:
     still made REAL progress — its shards live on the surviving buddies and
     the next launch restores them without touching shared storage — so the
     crash-loop detector must count it, or a run surviving on peer restores
-    would read as a crash loop and the supervisor would give up mid-save."""
-    progress = checkpoint_progress(ckpt_dir)
+    would read as a crash loop and the supervisor would give up mid-save.
+
+    Both sides are NORMALIZED with peer.progress_key — a boundary save of
+    epoch e, recorded as (e, 0), means e is COMPLETE and counts as
+    (e + 1, 0) — so an epoch-completing peer version is never outranked by
+    a stale mid-epoch Orbax frontier (e, s) of the same epoch. (0, 0) means
+    no durable progress at all."""
+    from vitax.checkpoint.peer import progress_key, store_frontier
+    epoch, step = checkpoint_progress(ckpt_dir)
+    progress = progress_key(epoch, step) if (epoch or step) else (0, 0)
     if peer_root:
-        from vitax.checkpoint.peer import store_frontier
         progress = max(progress, store_frontier(peer_root))
     return progress
 
