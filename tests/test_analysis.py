@@ -353,8 +353,11 @@ def test_check_invariants_json_schema(devices8):
     assert proc.returncode == 0, proc.stderr[-2000:]
     doc = json.loads(proc.stdout)
     assert doc["schema"] == 1
-    assert set(doc) == {"schema", "arms", "findings", "errors", "ok"}
+    assert set(doc) == {"schema", "arms", "findings", "errors",
+                        "concurrency", "ok"}
     assert doc["ok"] is True and doc["errors"] == {}
+    assert doc["concurrency"]["ok"] is True
+    assert doc["concurrency"]["findings"] == []
     arm = doc["arms"]["zero3"]
     assert set(arm) == {"ok", "rules_ran", "findings"}
     assert arm["rules_ran"] == ["VTX-R001", "VTX-R002", "VTX-R003", "VTX-R005"]

@@ -20,6 +20,8 @@ JSON contract (schema 1):
                         "findings": [{rule, severity, arm, message, details}]}},
      "findings": [...all findings...],
      "errors": {"<arm>": "<traceback tail>"},   # arms that failed to build
+     "concurrency": {"ok": bool,                # VTX200-series thread lint
+                     "findings": [{code, severity, path, line, message}]},
      "ok": bool}
 
 Exit status: 0 when every requested arm built and produced no ERROR-severity
@@ -77,6 +79,21 @@ def run(arms, as_json):
                   f"rules: {', '.join(ran) if ran else 'none applicable'}")
             for f in findings:
                 print(f"    {f.rule} [{f.severity}] {f.message}")
+
+    # host-program concurrency discipline (vitax.analysis.concurrency):
+    # same gate, different program — the thread model is as much a compiled
+    # invariant of this codebase as the HLO properties above
+    from vitax.analysis import concurrency as C
+    cfinds = C.lint_paths(["vitax", "tools"])
+    conc_ok = not cfinds
+    report["concurrency"] = {"ok": conc_ok,
+                             "findings": [f.to_json() for f in cfinds]}
+    report["ok"] = report["ok"] and conc_ok
+    if not as_json:
+        status = "ok" if conc_ok else "FAIL"
+        print(f"[concurrency] {status} — VTX200-series over vitax/ + tools/")
+        for f in cfinds:
+            print(f"    {f.format()}")
     return report
 
 

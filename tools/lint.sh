@@ -20,7 +20,9 @@ for path in vitax/telemetry tools/metrics_report.py \
             vitax/data/stream tools/make_shards.py tests/test_stream.py \
             vitax/train/control.py tests/test_control.py \
             vitax/checkpoint/snapshot.py vitax/checkpoint/peer.py \
-            tests/test_snapshot.py; do
+            tests/test_snapshot.py \
+            vitax/analysis/concurrency.py vitax/telemetry/threads.py \
+            tests/test_concurrency_lint.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
@@ -29,6 +31,13 @@ done
 
 # AST lint: stdlib-only, always runs (VTX1xx source findings)
 python -m vitax.analysis.ast_lint || exit 1
+
+# concurrency lint: per-class thread model + VTX200-series rules over the
+# threaded runtime AND its tools. VITAX_LINT_SKIP_CONCURRENCY=1 is the
+# escape hatch while triaging a new finding.
+if [ "${VITAX_LINT_SKIP_CONCURRENCY:-0}" != "1" ]; then
+    python -m vitax.analysis.concurrency vitax tools || exit 1
+fi
 
 # compiled-program invariants, fast arm subset (VTX-Rnnn; rules.FAST_ARMS —
 # one train arm exercising every train rule, plus the serve arm).

@@ -92,6 +92,10 @@ def summarize(path: str) -> dict:
         "fault_events": sum(1 for e in events if e.get("kind") == "fault"),
         "hang_escalations": sum(1 for e in events
                                 if e.get("kind") == "hang_escalation"),
+        # vitax/telemetry/threads.py excepthook: uncaught background-thread
+        # exceptions (healthy runs hold this at 0)
+        "thread_crashes": sum(1 for e in events
+                              if e.get("kind") == "thread_crash"),
         # fleet serving (vitax/serve/fleet/ writes these into serve.jsonl —
         # point this report at it for the overload/rotation story)
         "admission_shed_count": sum(1 for e in events
@@ -200,6 +204,8 @@ def print_human(summary: dict) -> None:
               f"{summary['hang_escalations']}")
     if summary.get("fault_events"):
         print(f"  injected faults fired: {summary['fault_events']}")
+    if summary.get("thread_crashes"):
+        print(f"  !! background thread crashes: {summary['thread_crashes']}")
     ce = summary.get("control_events") or {}
     if any(ce.values()):
         print(f"  !! control plane: {ce['agreed_preemptions']} agreed "

@@ -120,6 +120,8 @@ class FleetSampler:
         self.ready_end = None
         self.fleet_size = None
         self.restarts_end = 0
+        # _sample runs on both the poll thread and the start/stop callers
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
@@ -132,12 +134,14 @@ class FleetSampler:
             return
         fleet = snap.get("fleet") or {}
         ready = fleet.get("ready")
-        if ready is not None:
-            self.ready_end = ready
-            self.ready_min = (ready if self.ready_min is None
-                              else min(self.ready_min, ready))
-        self.fleet_size = fleet.get("size", self.fleet_size)
-        self.restarts_end = fleet.get("replica_restarts", self.restarts_end)
+        with self._lock:
+            if ready is not None:
+                self.ready_end = ready
+                self.ready_min = (ready if self.ready_min is None
+                                  else min(self.ready_min, ready))
+            self.fleet_size = fleet.get("size", self.fleet_size)
+            self.restarts_end = fleet.get("replica_restarts",
+                                          self.restarts_end)
 
     def _loop(self) -> None:
         while not self._stop.wait(timeout=self.period_s):
@@ -151,12 +155,13 @@ class FleetSampler:
         self._stop.set()
         self._thread.join(timeout=5.0)
         self._sample()
-        return {
-            "replicas": self.fleet_size,
-            "ready_min": self.ready_min,
-            "ready_end": self.ready_end,
-            "replica_restarts": self.restarts_end,
-        }
+        with self._lock:
+            return {
+                "replicas": self.fleet_size,
+                "ready_min": self.ready_min,
+                "ready_end": self.ready_end,
+                "replica_restarts": self.restarts_end,
+            }
 
 
 def summarize_serve_jsonl(path: str, since: float) -> dict:

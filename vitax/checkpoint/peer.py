@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from vitax import faults
+from vitax.telemetry.threads import join_or_warn
 from vitax.utils.logging import master_print
 
 PyTree = Any
@@ -370,7 +371,9 @@ class PeerReplicator:
     def stop(self) -> None:
         self._stop.set()
         if self._receiver is not None:
-            self._receiver.join(timeout=self.poll_s + 1.0)
+            # bounded: a receiver wedged in a KV fetch must not block
+            # process exit — warn loudly and leak it instead
+            join_or_warn(self._receiver, timeout=self.poll_s + 1.0)
             self._receiver = None
 
     def _receive(self) -> None:

@@ -61,6 +61,8 @@ from typing import Any, List, Optional, Tuple
 import jax
 import numpy as np
 
+from vitax.telemetry.threads import join_or_warn
+
 PyTree = Any
 
 
@@ -355,8 +357,9 @@ class SnapshotPipeline:
         self.raise_pending()
 
     def raise_pending(self) -> None:
-        if self._errors:
-            err = self._errors.pop(0)
+        with self._cond:  # vs the worker's append in _run
+            err = self._errors.pop(0) if self._errors else None
+        if err is not None:
             raise RuntimeError(
                 "snapshot pipeline: a background save/replicate job "
                 "failed") from err
@@ -369,8 +372,10 @@ class SnapshotPipeline:
             return
         self._closed = True
         self._q.put(None)
-        self._worker.join(timeout=60.0)
-        for err in self._errors:
+        join_or_warn(self._worker, timeout=60.0)
+        with self._cond:
+            errors = list(self._errors)
+        for err in errors:
             print(f"vitax.snapshot: background job failed "
                   f"({type(err).__name__}: {err})", file=sys.stderr,
                   flush=True)
@@ -405,7 +410,8 @@ class SnapshotPipeline:
             try:
                 job()
             except BaseException as e:  # noqa: BLE001 — surfaced at the next submit/drain, never lost
-                self._errors.append(e)
+                with self._cond:
+                    self._errors.append(e)
                 print(f"vitax.snapshot: background job failed "
                       f"({type(e).__name__}: {e})", file=sys.stderr,
                       flush=True)

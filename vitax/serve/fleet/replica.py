@@ -151,7 +151,8 @@ class ReplicaManager:
                name: Optional[str] = None) -> Replica:
         """Spawn a replica subprocess and own its lifecycle (restart on
         death, SIGTERM drain on stop)."""
-        name = name or f"replica_{len(self.replicas)}"
+        with self._lock:
+            name = name or f"replica_{len(self.replicas)}"
         replica = Replica(name, url, argv=argv, proc=self._spawn(list(argv)))
         with self._lock:
             self.replicas.append(replica)
@@ -161,7 +162,8 @@ class ReplicaManager:
     def adopt(self, url: str, name: Optional[str] = None) -> Replica:
         """Register an externally started replica: health-checked and
         rotated, never restarted (its lifecycle belongs to someone else)."""
-        name = name or f"replica_{len(self.replicas)}"
+        with self._lock:
+            name = name or f"replica_{len(self.replicas)}"
         replica = Replica(name, url)
         with self._lock:
             self.replicas.append(replica)
@@ -216,7 +218,9 @@ class ReplicaManager:
         """One health sweep over the fleet (the background loop calls this
         every health_interval_s; tests call it directly)."""
         now = self._clock() if now is None else now
-        for replica in list(self.replicas):
+        with self._lock:  # manage()/adopt() append concurrently
+            fleet = list(self.replicas)
+        for replica in fleet:
             self._poll_replica(replica, now)
 
     def _poll_replica(self, r: Replica, now: float) -> None:
@@ -315,7 +319,9 @@ class ReplicaManager:
         if self._thread is not None:
             self._thread.join(timeout=self.health_interval_s * 4 + 5.0)
             self._thread = None
-        for r in list(self.replicas):
+        with self._lock:
+            fleet = list(self.replicas)
+        for r in fleet:
             if r.proc is not None and r.proc.poll() is None:
                 rc = terminate_child(r.proc, self.term_grace_s,
                                      sleep=self._sleep)

@@ -288,8 +288,8 @@ def start_router(router: Router, port: int):
     httpd.server_address[1] is the bound port (0 = ephemeral, tests)."""
     httpd = ThreadingHTTPServer(("0.0.0.0", port), _make_handler(router))
     httpd.daemon_threads = True
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
-                              name="vitax-fleet-router")
+    thread = threading.Thread(  # vtx: ignore[VTX205] stop_router's httpd.shutdown() ends serve_forever
+        target=httpd.serve_forever, daemon=True, name="vitax-fleet-router")
     thread.start()
     return httpd
 

@@ -48,6 +48,7 @@ from vitax.config import Config
 from vitax.serve.engine import InferenceEngine
 from vitax.serve.batcher import DynamicBatcher, QueueFull
 from vitax.platform import device_kind
+from vitax.telemetry.threads import install_thread_excepthook
 from vitax.utils.logging import master_print
 
 # acceptance contract of a serve_request record: tools/serve_bench.py and
@@ -372,12 +373,15 @@ def start_server(cfg: Config, engine: InferenceEngine,
     port=0 / --serve_port 0 for an ephemeral one — tests do). Call
     `stop_server(httpd, ctx)` to drain and shut down."""
     recorder = build_serve_recorder(cfg)
+    # batcher worker + HTTP handler threads: crashes become thread_crash
+    # events in serve.jsonl instead of silent 500s-forever
+    install_thread_excepthook(recorder, rank=0)
     ctx = ServeContext(cfg, engine, recorder=recorder)
     bind_port = cfg.serve_port if port is None else port
     httpd = ThreadingHTTPServer(("0.0.0.0", bind_port), _make_handler(ctx))
     httpd.daemon_threads = True
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
-                              name="vitax-serve-http")
+    thread = threading.Thread(  # vtx: ignore[VTX205] stop_server's httpd.shutdown() ends serve_forever
+        target=httpd.serve_forever, daemon=True, name="vitax-serve-http")
     thread.start()
     if recorder is not None:
         recorder.event("serve_start", port=httpd.server_address[1],

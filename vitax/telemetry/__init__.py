@@ -5,6 +5,8 @@ Subsystem map:
   sinks      JSONL event log (always-on) + optional TensorBoard mirror
   record     Recorder: versioned per-step records fanned out to sinks
   watchdog   heartbeat hang detector: all-thread stack + memory dumps
+  threads    thread-crash excepthook (kind:"thread_crash" events) and
+             bounded shutdown joins with leaked-thread warnings
 
 Wired through the training stack by vitax/train/loop.py (Recorder lifecycle,
 per-log-step records, watchdog pets), vitax/data/loader.py (host batch-wait
@@ -20,4 +22,6 @@ from vitax.telemetry.record import (  # noqa: F401
     REQUIRED_STEP_KEYS, SCHEMA_VERSION, Recorder, build_recorder)
 from vitax.telemetry.sinks import (  # noqa: F401
     JsonlSink, TensorBoardSink, make_tensorboard_sink)
+from vitax.telemetry.threads import (  # noqa: F401
+    install_thread_excepthook, join_or_warn, thread_crash_count)
 from vitax.telemetry.watchdog import Watchdog, dump_all_stacks  # noqa: F401

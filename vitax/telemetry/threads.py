@@ -1,0 +1,99 @@
+"""Background-thread crash visibility + bounded shutdown joins.
+
+vitax runs ~a dozen named background threads (batcher, watchdog, fleet
+health, loader producers, heartbeats, snapshot writer, peer receiver).
+By default an uncaught exception in any of them prints a traceback and
+the thread dies — the process keeps running minus one vital organ, which
+at pod scale reads as "the log stopped" hours later. Two primitives fix
+the two halves of that failure mode:
+
+- `install_thread_excepthook(recorder, rank)` routes every uncaught
+  background-thread exception through one `threading.excepthook`: a
+  rank-tagged traceback to stderr plus a `kind:"thread_crash"` JSONL
+  event when a Recorder is attached (surfaced as the `thread_crashes`
+  counter in tools/metrics_report.py --json). SystemExit keeps its
+  stdlib meaning (threads may exit deliberately).
+
+- `join_or_warn(thread, timeout)` bounds a shutdown join: a wedged
+  worker gets `timeout` seconds, then a loud leaked-thread warning on
+  stderr instead of blocking process exit forever. Used by
+  SnapshotPipeline.close() and PeerReplicator.stop().
+
+Both are host-side and jax-free; safe to import from anywhere.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Optional
+
+_state_lock = threading.Lock()
+_crash_count = 0
+_installed = False
+_recorder = None
+_rank = 0
+
+
+def install_thread_excepthook(recorder=None, rank: int = 0) -> None:
+    """Install (idempotently) the crash hook; rebinds recorder/rank when
+    called again — the train loop installs early with recorder=None, then
+    re-installs once the Recorder exists."""
+    global _installed
+    with _state_lock:
+        globals()["_recorder"] = recorder
+        globals()["_rank"] = int(rank)
+        already = _installed
+        _installed = True
+    if not already:
+        threading.excepthook = _excepthook
+
+
+def thread_crash_count() -> int:
+    """Uncaught background-thread exceptions seen since install (tests and
+    shutdown paths assert this stays 0 on healthy runs)."""
+    with _state_lock:
+        return _crash_count
+
+
+def _excepthook(args) -> None:
+    global _crash_count
+    if args.exc_type is SystemExit:
+        return  # deliberate thread exit — same semantics as the default hook
+    with _state_lock:
+        _crash_count += 1
+        recorder, rank = _recorder, _rank
+    name = args.thread.name if args.thread is not None else "unknown"
+    tb = "".join(traceback.format_exception(
+        args.exc_type, args.exc_value, args.exc_traceback))
+    print(f"[vitax.threads rank {rank}] uncaught exception in background "
+          f"thread `{name}`:\n{tb}", file=sys.stderr, flush=True)
+    if recorder is not None:
+        try:  # JSONL sinks flush per record — the event survives a dying run
+            recorder.event(
+                "thread_crash", rank=rank, thread=name,
+                error=f"{args.exc_type.__name__}: {args.exc_value}")
+        except Exception as e:  # noqa: BLE001 — a broken sink must not recurse
+            print(f"[vitax.threads rank {rank}] thread_crash event sink "
+                  f"failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+
+
+def join_or_warn(thread: Optional[threading.Thread], timeout: float,
+                 what: Optional[str] = None, rank: int = 0) -> bool:
+    """Join `thread` for at most `timeout` seconds. Returns True when the
+    thread is gone; on timeout prints a loud leaked-thread warning and
+    returns False — shutdown paths must keep going, not hang."""
+    if thread is None or not thread.is_alive():
+        return True
+    thread.join(timeout=timeout)
+    if thread.is_alive():
+        name = what or thread.name
+        print(f"[vitax.threads rank {rank}] thread `{name}` still alive "
+              f"{timeout:.0f}s after shutdown was requested — leaking it "
+              "rather than blocking process exit (inspect with the "
+              "watchdog's all-thread stack dump)", file=sys.stderr,
+              flush=True)
+        return False
+    return True

@@ -101,6 +101,11 @@ class Watchdog:
         # poll often enough to notice promptly, rarely enough to cost nothing
         self.poll_s = poll_s if poll_s else min(max(timeout_s / 4.0, 0.05), 5.0)
         self.fire_count = 0
+        # guards the pet/deadline words shared between the loop thread (pet,
+        # request/acknowledge escalation, arm_exit_deadline) and _run: float
+        # stores are atomic under the GIL, but the dump-once logic needs
+        # _last_pet and _fired_since_pet to move together (VTX200)
+        self._lock = threading.Lock()
         self._last_pet = time.monotonic()
         self._fired_since_pet = False
         self._escalated = threading.Event()
@@ -118,7 +123,8 @@ class Watchdog:
         return self._thread is not None
 
     def start(self) -> "Watchdog":
-        self._last_pet = time.monotonic()
+        with self._lock:
+            self._last_pet = time.monotonic()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="vitax-watchdog")
         self._thread.start()
@@ -128,8 +134,9 @@ class Watchdog:
         """The loop made progress; re-arm the dump (NOT the escalation: once
         requested, the loop must checkpoint and exit — a step that limps
         through after a real hang is not a healthy run)."""
-        self._last_pet = time.monotonic()
-        self._fired_since_pet = False
+        with self._lock:
+            self._last_pet = time.monotonic()
+            self._fired_since_pet = False
 
     def escalation_requested(self) -> bool:
         """Sticky: True once a stall under action="checkpoint_exit" dumped."""
@@ -144,7 +151,8 @@ class Watchdog:
         if self._escalated.is_set():
             return
         # same ordering contract as _escalate: deadline armed BEFORE the flag
-        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
+        with self._lock:
+            self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
         self._escalated.set()
         if self.on_escalate is not None:
             try:
@@ -161,7 +169,8 @@ class Watchdog:
         """The loop saw the flag and is taking the emergency checkpoint:
         push the hard-exit deadline out by another hard_deadline_s so the
         save itself runs under the same bounded protection."""
-        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
+        with self._lock:
+            self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
 
     def arm_exit_deadline(self) -> None:
         """Bound a blocking exit-path collective (the coordinated preemption
@@ -172,7 +181,8 @@ class Watchdog:
         and the supervisor restarts from the checkpoint this host just
         committed. A clean barrier return is followed by stop(), which
         halts the watchdog thread long before the deadline can fire."""
-        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
+        with self._lock:
+            self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
 
     def stop(self) -> None:
         self._stop.set()
@@ -181,12 +191,20 @@ class Watchdog:
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
-            stalled = time.monotonic() - self._last_pet
-            if stalled >= self.timeout_s and not self._fired_since_pet:
-                self._fired_since_pet = True  # once per stall, not per poll
+            now = time.monotonic()
+            with self._lock:
+                stalled = now - self._last_pet
+                fire = (stalled >= self.timeout_s
+                        and not self._fired_since_pet)
+                if fire:
+                    self._fired_since_pet = True  # once per stall, not poll
+                hard = (self._hard_deadline_at is not None
+                        and now >= self._hard_deadline_at)
+            # dump and exit OUTSIDE the lock: both run user sinks that may
+            # call pet()/acknowledge_escalation() back into us
+            if fire:
                 self._fire(stalled)
-            if (self._hard_deadline_at is not None
-                    and time.monotonic() >= self._hard_deadline_at):
+            if hard:
                 self._hard_exit_now()
 
     def _hard_exit_now(self) -> None:
@@ -203,7 +221,8 @@ class Watchdog:
                 print(f"[vitax.watchdog rank {self.rank}] on_hard_exit sink "
                       f"failed: {type(e).__name__}: {e}",
                       file=sys.stderr, flush=True)
-        self._hard_deadline_at = None  # a test's fake exit returns; disarm
+        with self._lock:
+            self._hard_deadline_at = None  # a fake test exit returns; disarm
         self._hard_exit(EXIT_HANG)
 
     def _fire(self, stalled_s: float) -> None:
@@ -241,7 +260,8 @@ class Watchdog:
         # order matters: arm the deadline BEFORE raising the flag, so a loop
         # that polls immediately can only ever see a flag whose deadline is
         # already running (acknowledge then safely re-arms it)
-        self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
+        with self._lock:
+            self._hard_deadline_at = time.monotonic() + self.hard_deadline_s
         self._escalated.set()
         if self.on_escalate is not None:
             try:  # JSONL sinks flush per record: the event survives the exit

@@ -364,12 +364,14 @@ class ControlPlane:
                   "detection disabled for this run",
                   file=sys.stderr, flush=True)
             return False
-        self._liveness = PeerLiveness(
+        liveness = PeerLiveness(
             process_index=self.process_index,
             process_count=self.process_count,
             interval_s=interval_s, grace_s=grace_s, client=client,
             on_loss=self._on_peer_loss)
-        self._liveness.start()
+        with self._lock:  # vs the monitor thread's read in _on_peer_loss
+            self._liveness = liveness
+        liveness.start()
         return True
 
     def _on_peer_loss(self, peer: int, silent_s: float,
@@ -382,7 +384,8 @@ class ControlPlane:
         reaches the next boundary first and exits through the coordinated
         path; either way the survivor is gone within the deadline instead
         of hanging in ICI forever."""
-        self._lost_peers.append(peer)
+        with self._lock:
+            self._lost_peers.append(peer)
         self._peer_lost.set()
         why = f" (peer published cause: {cause})" if cause else ""
         print(f"vitax.control: peer {peer} lost — no heartbeat for "
@@ -391,8 +394,9 @@ class ControlPlane:
               file=sys.stderr, flush=True)
         self._emit("peer_loss", peer=int(peer), silent_s=round(silent_s, 3),
                    cause=cause, exit_code=EXIT_HANG)
-        deadline_s = (self._liveness.grace_s if self._liveness is not None
-                      else 30.0)
+        with self._lock:  # stop() may be nulling _liveness concurrently
+            liveness = self._liveness
+        deadline_s = liveness.grace_s if liveness is not None else 30.0
         if self.watchdog is not None:
             self.watchdog.request_escalation(
                 f"peer {peer} lost (heartbeat silent {silent_s:.1f}s)")
@@ -458,7 +462,8 @@ class ControlPlane:
         monitor reaches its verdict (waiting up to grace + one beat interval
         when `wait`), or None — no liveness running, or every peer still
         beating, i.e. the error is a genuine bug the caller must re-raise."""
-        liveness = self._liveness
+        with self._lock:
+            liveness = self._liveness
         if liveness is None:
             return None
         # worst case the peer died a whole grace window before the error
@@ -472,12 +477,14 @@ class ControlPlane:
             time.sleep(min(liveness.interval_s, 0.2))
         if not self._peer_lost.is_set():
             return None
-        return self._lost_peers[0] if self._lost_peers else None
+        with self._lock:
+            return self._lost_peers[0] if self._lost_peers else None
 
     def stop(self) -> None:
-        if self._liveness is not None:
-            self._liveness.stop()
-            self._liveness = None
+        with self._lock:
+            liveness, self._liveness = self._liveness, None
+        if liveness is not None:
+            liveness.stop()  # joins its threads — must not hold our lock
         with self._lock:
             if self._exit_timer is not None:
                 self._exit_timer.cancel()

@@ -29,7 +29,8 @@ from vitax.parallel.mesh import BATCH_AXES, build_mesh
 from vitax.train.control import ControlPlane
 from vitax.train.state import TrainState, build_optimizer, make_train_state
 from vitax.train.step import make_eval_step, make_train_step
-from vitax.telemetry import Watchdog, build_recorder
+from vitax.telemetry import (Watchdog, build_recorder,
+                             install_thread_excepthook)
 from vitax.telemetry.watchdog import EXIT_HANG
 from vitax.utils.logging import master_print, memory_summary
 from vitax.utils.metrics import SmoothedValue
@@ -244,6 +245,11 @@ def train(cfg: Config) -> TrainState:
     recorder = build_recorder(cfg, jax.device_count(),
                               platform.device_kind(),
                               rank=jax.process_index())
+    # uncaught exceptions in ANY background thread (loader producers,
+    # watchdog, heartbeats, snapshot writer, peer receiver) become
+    # rank-tagged stderr tracebacks + kind:"thread_crash" events instead
+    # of silent thread deaths (recorder=None still tags stderr)
+    install_thread_excepthook(recorder, rank=jax.process_index())
     if recorder is not None:
         master_print(f"telemetry: JSONL step records -> {cfg.metrics_dir} "
                      f"(MFU vs {recorder.peak_tflops:.0f} TF/s/chip peak"
