@@ -592,7 +592,8 @@ def test_fleet_metrics_aggregation(fleet_factory):
     for key in ("latency_s_p50", "latency_s_p95", "latency_s_p99"):
         assert snap[key] is not None and snap[key] > 0
     assert snap["fleet"] == {"size": 2, "ready": 2, "in_flight": 0,
-                             "replica_restarts": 0}
+                             "replica_restarts": 0, "degraded": 0,
+                             "degraded_seconds": 0.0}
     assert set(snap["replicas"]) == {"a", "b"}
     total = 0
     for name, rsnap in snap["replicas"].items():

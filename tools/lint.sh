@@ -22,15 +22,18 @@ for path in vitax/telemetry tools/metrics_report.py \
             vitax/checkpoint/snapshot.py vitax/checkpoint/peer.py \
             tests/test_snapshot.py \
             vitax/analysis/concurrency.py vitax/telemetry/threads.py \
-            tests/test_concurrency_lint.py; do
+            tests/test_concurrency_lint.py \
+            vitax/serve/fleet/breaker.py tests/test_chaos.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
     fi
 done
 
-# AST lint: stdlib-only, always runs (VTX1xx source findings)
-python -m vitax.analysis.ast_lint || exit 1
+# AST lint: stdlib-only, always runs (VTX1xx source findings). tools/ is
+# in scope too: VTX109 (network calls without timeout=) guards the bench
+# and report CLIs as much as the serving tree.
+python -m vitax.analysis.ast_lint vitax tools || exit 1
 
 # concurrency lint: per-class thread model + VTX200-series rules over the
 # threaded runtime AND its tools. VITAX_LINT_SKIP_CONCURRENCY=1 is the

@@ -102,6 +102,25 @@ def summarize(path: str) -> dict:
                                     if e.get("kind") == "admission"),
         "replica_restarts": sum(1 for e in events
                                 if e.get("kind") == "replica_restart"),
+        # serve-path chaos layer (vitax/faults.py serve sites + the
+        # router's containment: breaker/budget/hedge/brownout events)
+        "serve_fault_events": sum(1 for e in events
+                                  if e.get("kind") == "serve_fault"),
+        "breaker_open_count": sum(
+            1 for e in events if e.get("kind") == "breaker"
+            and e.get("event") in ("open", "reopen")),
+        "retry_budget_exhausted": sum(
+            1 for e in events if e.get("kind") == "retry_budget"
+            and e.get("event") == "exhausted"),
+        "hedge_count": sum(1 for e in events if e.get("kind") == "hedge"
+                           and e.get("event") == "fired"),
+        "hedge_wins": sum(1 for e in events if e.get("kind") == "hedge"
+                          and e.get("event") == "win"),
+        # completed brownout episodes only (exit events carry the length;
+        # a run killed while degraded under-counts by the live episode)
+        "brownout_seconds": round(sum(
+            float(e.get("degraded_s", 0.0)) for e in events
+            if e.get("kind") == "brownout" and e.get("event") == "exit"), 3),
     }
     # control plane (vitax/train/control.py + the supervisor's elastic
     # restarts): kind:"control" records, bucketed by their `event` field
@@ -234,6 +253,20 @@ def print_human(summary: dict) -> None:
         print(f"  admission sheds (429): {summary['admission_shed_count']}")
     if summary.get("replica_restarts"):
         print(f"  !! fleet replica restarts: {summary['replica_restarts']}")
+    if summary.get("serve_fault_events"):
+        print(f"  injected serve faults fired: "
+              f"{summary['serve_fault_events']}")
+    if summary.get("breaker_open_count"):
+        print(f"  !! circuit breaker opens: {summary['breaker_open_count']}")
+    if summary.get("retry_budget_exhausted"):
+        print(f"  !! retry budget exhaustions (fast 503): "
+              f"{summary['retry_budget_exhausted']}")
+    if summary.get("hedge_count"):
+        print(f"  hedged requests: {summary['hedge_count']} "
+              f"({summary['hedge_wins']} won)")
+    if summary.get("brownout_seconds"):
+        print(f"  !! brownout (degraded mode): "
+              f"{summary['brownout_seconds']:.1f}s across completed episodes")
     ev = summary.get("eval_last")
     if ev:
         print(f"  eval (epoch {ev['epoch']}): top1 {ev['top1']:.4f}  "

@@ -62,7 +62,7 @@ def main():
     from vitax.train.step import make_train_step
 
     n_dev = jax.device_count()
-    device_kind = jax.devices()[0].device_kind
+    device_kind = jax.devices()[0].device_kind  # vtx: ignore[VTX104] CLI entry point: labels the backend being profiled
     # presets and remat defaults come FROM bench.py so traces explain exactly
     # the configs the bench measures
     from bench import train_presets

@@ -24,7 +24,21 @@ Fleet mode (target = a vitax.serve.fleet router):
   client p99 of successful requests is within D and errors == 0;
 - `--replicas N` samples the router's /metrics during the run and reports
   rotation (ready_min/ready_end) and replica_restarts — a kill-a-replica
-  drill shows up here, not in the error count.
+  drill shows up here, not in the error count — plus the containment
+  counters (hedged, breaker_opens, degraded_seconds, retry budget);
+- errors carry a taxonomy: `errors_by_class` buckets connection_refused /
+  reset_mid_body / timeout / http_5xx / other, so a drill can assert
+  *which* failure mode leaked to clients, not just how many;
+- 503s that carry Retry-After are `unavailable`, not errors: like 429
+  sheds they are the fleet's bounded-degradation contract (retry budget
+  exhausted, no ready replicas) and the worker honors the backoff.
+
+Chaos mode (`--chaos '<fault plan json>'`): before the burst, POST the
+plan to every replica's /chaos endpoint (URLs discovered from the
+router's /metrics; replicas must run with --serve_allow_chaos) so a
+drill can crash/hang/flap replicas mid-burst and assert the client view
+stayed inside the 200/429/503+Retry-After contract. See vitax/faults.py
+for the plan grammar and site names.
 
 stdlib-only (urllib + threading): the bench must run on bare CI hosts.
 Exit status: 0 when every request succeeded (sheds are not errors),
@@ -36,6 +50,7 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import socket
 import sys
 import threading
 import time
@@ -68,13 +83,51 @@ def make_image_bytes(image_size: int, seed: int = 0) -> bytes:
     return buf.getvalue()
 
 
+def classify_error(exc: Exception) -> str:
+    """Bucket a client-visible failure for `errors_by_class`: the drill
+    question is WHICH mechanism leaked (a refused connect means routing
+    sent traffic to a corpse; a reset mid-body means a replica died while
+    answering; a timeout means a hang was not contained)."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return "http_5xx" if exc.code >= 500 else "other"
+    if isinstance(exc, (socket.timeout, TimeoutError)):
+        return "timeout"
+    # urllib wraps socket errors in URLError(reason=<OSError>)
+    reason = getattr(exc, "reason", exc)
+    if isinstance(reason, ConnectionRefusedError):
+        return "connection_refused"
+    if isinstance(reason, (ConnectionResetError, ConnectionAbortedError)):
+        return "reset_mid_body"
+    if isinstance(reason, (socket.timeout, TimeoutError)):
+        return "timeout"
+    text = str(exc).lower()
+    if "refused" in text:
+        return "connection_refused"
+    if "reset" in text or "aborted" in text:
+        return "reset_mid_body"
+    if "timed out" in text or "timeout" in text:
+        return "timeout"
+    return "other"
+
+
+def _retry_after_s(e: urllib.error.HTTPError) -> float:
+    try:
+        return float(e.headers.get("Retry-After", "1"))
+    except (TypeError, ValueError):
+        return 1.0
+
+
 def run_worker(url: str, body: bytes, n_requests: int, timeout: float,
                latencies: list, errors: list, lock: threading.Lock,
-               sheds: list = None, interval_s: float = 0.0) -> None:
+               sheds: list = None, interval_s: float = 0.0,
+               unavailable: list = None) -> None:
     """One closed-loop worker. `interval_s` > 0 paces to an offered rate
     (open-ish loop: sleep out the remainder of the interval after each
     response); `sheds` collects 429 admission responses separately from
-    errors — shedding under overload is contract behavior, not failure."""
+    errors — shedding under overload is contract behavior, not failure —
+    and `unavailable` likewise collects 503+Retry-After (the fleet's
+    bounded-degradation answer: retry budget dry, no ready replicas).
+    `errors` entries are (class, detail) pairs — see classify_error."""
     for _ in range(n_requests):
         req = urllib.request.Request(
             url + "/predict", data=body,
@@ -88,20 +141,25 @@ def run_worker(url: str, body: bytes, n_requests: int, timeout: float,
                 latencies.append(time.time() - t0)
         except urllib.error.HTTPError as e:
             if e.code == 429 and sheds is not None:
-                retry_after = 1.0
-                try:
-                    retry_after = float(e.headers.get("Retry-After", "1"))
-                except (TypeError, ValueError):
-                    pass
+                retry_after = _retry_after_s(e)
                 with lock:
                     sheds.append(retry_after)
                 time.sleep(min(max(retry_after, 0.0), 1.0))
+            elif (e.code == 503 and unavailable is not None
+                    and e.headers is not None
+                    and e.headers.get("Retry-After") is not None):
+                # contract degradation, not failure: back off as told
+                retry_after = _retry_after_s(e)
+                with lock:
+                    unavailable.append(retry_after)
+                time.sleep(min(max(retry_after, 0.0), 1.0))
             else:
                 with lock:
-                    errors.append(f"HTTPError: {e.code}")
+                    errors.append((classify_error(e), f"HTTPError: {e.code}"))
         except Exception as e:  # noqa: BLE001 — count, keep loading
             with lock:
-                errors.append(f"{type(e).__name__}: {e}")
+                errors.append(
+                    (classify_error(e), f"{type(e).__name__}: {e}"))
         if interval_s > 0:
             leftover = interval_s - (time.time() - t0)
             if leftover > 0:
@@ -120,6 +178,11 @@ class FleetSampler:
         self.ready_end = None
         self.fleet_size = None
         self.restarts_end = 0
+        self.hedged = 0
+        self.hedge_wins = 0
+        self.breaker_opens = 0
+        self.degraded_seconds = 0.0
+        self.retry_budget_exhausted = 0
         # _sample runs on both the poll thread and the start/stop callers
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -134,6 +197,7 @@ class FleetSampler:
             return
         fleet = snap.get("fleet") or {}
         ready = fleet.get("ready")
+        budget = snap.get("retry_budget") or {}
         with self._lock:
             if ready is not None:
                 self.ready_end = ready
@@ -142,6 +206,20 @@ class FleetSampler:
             self.fleet_size = fleet.get("size", self.fleet_size)
             self.restarts_end = fleet.get("replica_restarts",
                                           self.restarts_end)
+            # containment counters (monotone on the router; keep the max
+            # so a failed final scrape never rolls them back)
+            self.hedged = max(self.hedged,
+                              snap.get("hedges_total", 0))
+            self.hedge_wins = max(self.hedge_wins,
+                                  snap.get("hedge_wins_total", 0))
+            self.breaker_opens = max(self.breaker_opens,
+                                     snap.get("breaker_opens", 0))
+            self.degraded_seconds = max(
+                self.degraded_seconds,
+                float(fleet.get("degraded_seconds") or 0.0))
+            self.retry_budget_exhausted = max(
+                self.retry_budget_exhausted,
+                budget.get("exhausted_total", 0))
 
     def _loop(self) -> None:
         while not self._stop.wait(timeout=self.period_s):
@@ -161,7 +239,41 @@ class FleetSampler:
                 "ready_min": self.ready_min,
                 "ready_end": self.ready_end,
                 "replica_restarts": self.restarts_end,
+                "hedged": self.hedged,
+                "hedge_wins": self.hedge_wins,
+                "breaker_opens": self.breaker_opens,
+                "degraded_seconds": round(self.degraded_seconds, 3),
+                "retry_budget_exhausted": self.retry_budget_exhausted,
             }
+
+
+def install_chaos(router_url: str, plan_json: str,
+                  timeout: float = 5.0) -> dict:
+    """Forward a fault plan (vitax/faults.py grammar) to every replica's
+    POST /chaos endpoint. Replica URLs come from the router's /metrics
+    snapshot; replicas must run with --serve_allow_chaos or they answer
+    403. Returns {replica_name: install result or error string}."""
+    with urllib.request.urlopen(router_url + "/metrics",
+                                timeout=timeout) as resp:
+        snap = json.load(resp)
+    replicas = snap.get("replicas") or {}
+    assert replicas, f"no replicas in {router_url}/metrics — not a fleet?"
+    results = {}
+    body = plan_json.encode("utf-8")
+    for name, info in sorted(replicas.items()):
+        url = info.get("url")
+        if not url:
+            results[name] = "no url in router snapshot"
+            continue
+        req = urllib.request.Request(
+            url + "/chaos", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                results[name] = json.load(resp)
+        except Exception as e:  # noqa: BLE001 — report per replica
+            results[name] = f"{type(e).__name__}: {e}"
+    return results
 
 
 def summarize_serve_jsonl(path: str, since: float) -> dict:
@@ -206,14 +318,16 @@ def summarize_serve_jsonl(path: str, since: float) -> dict:
 def run_bench(url: str, concurrency: int, requests_per_worker: int,
               image_size: int, timeout: float, serve_jsonl: str = "",
               target_rps: float = 0.0, slo_p99_ms: float = 0.0,
-              replicas: int = 0) -> dict:
+              replicas: int = 0, chaos: str = "") -> dict:
     body = make_image_bytes(image_size)
     latencies: list = []
     errors: list = []
     sheds: list = []
+    unavailable: list = []
     lock = threading.Lock()
     # pacing: each of C workers owns 1/C of the offered rate
     interval_s = concurrency / target_rps if target_rps > 0 else 0.0
+    chaos_installed = install_chaos(url, chaos) if chaos else None
     sampler = FleetSampler(url) if replicas > 0 else None
     if sampler is not None:
         sampler.start()
@@ -221,7 +335,7 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
     workers = [threading.Thread(
         target=run_worker,
         args=(url, body, requests_per_worker, timeout, latencies, errors,
-              lock, sheds, interval_s), daemon=True)
+              lock, sheds, interval_s, unavailable), daemon=True)
         for _ in range(concurrency)]
     for w in workers:
         w.start()
@@ -229,14 +343,19 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
         w.join()
     elapsed = time.time() - t_start
     lat = sorted(latencies)
+    by_class: dict = {}
+    for cls, _ in errors:
+        by_class[cls] = by_class.get(cls, 0) + 1
     summary = {
         "url": url,
         "concurrency": concurrency,
         "requests": concurrency * requests_per_worker,
         "completed": len(lat),
         "errors": len(errors),
-        "error_samples": errors[:3],
+        "errors_by_class": by_class,
+        "error_samples": [msg for _, msg in errors[:3]],
         "shed": len(sheds),
+        "unavailable": len(unavailable),
         "shed_fraction": round(
             len(sheds) / max(concurrency * requests_per_worker, 1), 4),
         "elapsed_s": round(elapsed, 3),
@@ -259,6 +378,8 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
         }
     if sampler is not None:
         summary["fleet"] = sampler.stop()
+    if chaos_installed is not None:
+        summary["chaos"] = chaos_installed
     if serve_jsonl:
         summary["server"] = summarize_serve_jsonl(serve_jsonl, since=t_start)
     return summary
@@ -267,8 +388,12 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
 def print_human(s: dict) -> None:
     print(f"bench: {s['url']} x{s['concurrency']} closed-loop")
     print(f"  {s['completed']}/{s['requests']} ok ({s['errors']} errors, "
-          f"{s['shed']} shed) in {s['elapsed_s']:.2f}s -> "
-          f"{s['throughput_rps']:.1f} req/s")
+          f"{s['shed']} shed, {s['unavailable']} unavailable) in "
+          f"{s['elapsed_s']:.2f}s -> {s['throughput_rps']:.1f} req/s")
+    if s["errors_by_class"]:
+        buckets = "  ".join(f"{k} {v}" for k, v
+                            in sorted(s["errors_by_class"].items()))
+        print(f"  errors by class: {buckets}")
     if s["latency_s_p50"] is not None:
         print(f"  client latency: p50 {1e3 * s['latency_s_p50']:.1f}ms  "
               f"p95 {1e3 * s['latency_s_p95']:.1f}ms  "
@@ -282,6 +407,14 @@ def print_human(s: dict) -> None:
         print(f"  fleet: {fleet['ready_end']}/{fleet['replicas']} ready at "
               f"end (min {fleet['ready_min']}), "
               f"{fleet['replica_restarts']} restarts")
+        if (fleet.get("hedged") or fleet.get("breaker_opens")
+                or fleet.get("degraded_seconds")
+                or fleet.get("retry_budget_exhausted")):
+            print(f"  containment: {fleet['hedged']} hedged "
+                  f"({fleet['hedge_wins']} wins), "
+                  f"{fleet['breaker_opens']} breaker opens, "
+                  f"{fleet['retry_budget_exhausted']} budget-exhausted, "
+                  f"degraded {fleet['degraded_seconds']:.1f}s")
     srv = s.get("server")
     if srv and srv["records"]:
         print(f"  server ({srv['records']} records): "
@@ -315,6 +448,10 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int, default=0,
                    help="expected fleet size: sample the router's /metrics "
                         "during the run and report rotation + restarts")
+    p.add_argument("--chaos", type=str, default="",
+                   help="fault plan JSON (vitax/faults.py grammar) POSTed "
+                        "to every replica's /chaos before the burst — "
+                        "replicas must run with --serve_allow_chaos")
     p.add_argument("--json", action="store_true",
                    help="emit the summary as one JSON object (CI mode)")
     args = p.parse_args(argv)
@@ -322,7 +459,8 @@ def main(argv=None) -> int:
     summary = run_bench(args.url, args.concurrency, args.requests,
                         args.image_size, args.timeout, args.serve_jsonl,
                         target_rps=args.target_rps,
-                        slo_p99_ms=args.slo_p99_ms, replicas=args.replicas)
+                        slo_p99_ms=args.slo_p99_ms, replicas=args.replicas,
+                        chaos=args.chaos)
     if args.json:
         print(json.dumps(summary, sort_keys=True))
     else:

@@ -35,6 +35,12 @@ Finding codes (Error Prone style: stable ids, CI-greppable):
                  deadlock); read the AGREED word via vitax/train/control.py
                  ControlPlane.poll instead. The control plane's own two
                  polls are the sanctioned (suppressed) call sites.
+  VTX109  ERROR  urllib.request.urlopen / http.client.HTTPConnection /
+                 socket.create_connection without an explicit timeout —
+                 the stdlib default is block-forever, so one hung peer
+                 wedges the calling thread (a health poll, a dispatch, a
+                 bench worker) permanently; every network call in the
+                 serving/tooling paths must bound its wait
   VTX108  ERROR  `save_state(..., wait=True)` inside a loop body — a
                  synchronous checkpoint write from the step-dispatch region
                  stalls the train loop for the full serialization+write
@@ -78,6 +84,16 @@ _FENCE_TOKENS = ("block_until_ready", "device_get", "asarray", ".result(",
                  ".item(", "np.array(")
 _DISPATCH_NAME_RE = re.compile(
     r"(step|predict|compiled|jitted|forward|apply|_run)", re.IGNORECASE)
+
+# VTX109: blocking network constructors/calls -> the 0-based positional
+# index where the stdlib signature accepts `timeout` (a call with more
+# positionals than that index passed it positionally)
+_NET_TIMEOUT_POS = {
+    "urlopen": 2,            # urlopen(url, data=None, timeout=...)
+    "create_connection": 1,  # socket.create_connection(addr, timeout=...)
+    "HTTPConnection": 2,     # HTTPConnection(host, port=..., timeout=...)
+    "HTTPSConnection": 2,
+}
 
 
 @dataclasses.dataclass
@@ -263,6 +279,17 @@ class _Visitor(ast.NodeVisitor):
                       "region; route it through SnapshotPipeline.submit "
                       "(vitax/checkpoint/snapshot.py) or hoist it out of "
                       "the loop")
+
+        if short in _NET_TIMEOUT_POS:
+            has_timeout = (
+                any(kw.arg == "timeout" or kw.arg is None  # **kwargs: assume ok
+                    for kw in node.keywords)
+                or len(node.args) > _NET_TIMEOUT_POS[short])
+            if not has_timeout:
+                self._add("VTX109", "ERROR", node,
+                          f"`{name or short}()` without an explicit timeout "
+                          "— the stdlib default blocks forever, so one hung "
+                          "peer wedges this thread; pass timeout=")
 
         if short in ("devices", "local_devices") and name.startswith("jax.") \
                 and not node.args and not node.keywords:

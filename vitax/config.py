@@ -206,6 +206,23 @@ class Config:
     serve_request_timeout_s: float = 60.0  # ceiling a /predict handler waits on its batch future before
     #   answering 503: batcher deadline + one engine batch + generous slack
     #   (was the hardcoded REQUEST_TIMEOUT_S); surfaced in /metrics
+    serve_brownout_enter_frac: float = 0.75  # brownout trigger: queue depth sustained at or above this
+    #   fraction of --serve_queue_max for --serve_brownout_dwell_s enters
+    #   degraded mode — topk clamped to 1, batcher deadline shortened to
+    #   --serve_brownout_wait_ms, `degraded: true` advertised in /healthz
+    #   and /metrics (vitax/serve/server.py BrownoutController). 0 = off
+    serve_brownout_exit_frac: float = 0.25  # hysteretic recovery: depth sustained at or below this
+    #   fraction for the same dwell exits degraded mode (must be <= the
+    #   enter fraction so the two thresholds cannot chatter)
+    serve_brownout_dwell_s: float = 2.0 # sustained-pressure window for BOTH brownout transitions:
+    #   blips shorter than this never flip the mode
+    serve_brownout_wait_ms: float = 1.0 # degraded-mode batcher flush deadline (replaces
+    #   --max_batch_wait_ms while browned out; restored on recovery)
+    serve_allow_chaos: bool = False     # arm POST /chaos: accepts a fault plan JSON body and
+    #   installs it live (vitax/faults.py serve sites) so drills can inject
+    #   into running replicas (tools/serve_bench.py --chaos). NEVER enable
+    #   on a production replica — the endpoint is deliberately off unless
+    #   this flag opts in
 
     @property
     def resolved_param_gather_dtype(self) -> str:
@@ -437,6 +454,24 @@ class Config:
             f"{self.serve_request_timeout_s}: a /predict handler that waits "
             f"zero seconds on its batch future would answer 503 before the "
             f"batcher could possibly flush")
+        assert 0.0 <= self.serve_brownout_enter_frac <= 1.0, (
+            f"--serve_brownout_enter_frac must be in [0, 1] (a fraction of "
+            f"--serve_queue_max; 0 = brownout off), got "
+            f"{self.serve_brownout_enter_frac}")
+        if self.serve_brownout_enter_frac > 0:
+            assert (0.0 <= self.serve_brownout_exit_frac
+                    <= self.serve_brownout_enter_frac), (
+                f"--serve_brownout_exit_frac must be in [0, "
+                f"enter_frac={self.serve_brownout_enter_frac}], got "
+                f"{self.serve_brownout_exit_frac}: an exit threshold above "
+                f"the enter threshold would make the hysteresis chatter")
+        assert self.serve_brownout_dwell_s >= 0, (
+            f"--serve_brownout_dwell_s must be >= 0, got "
+            f"{self.serve_brownout_dwell_s}")
+        assert self.serve_brownout_wait_ms >= 0, (
+            f"--serve_brownout_wait_ms must be >= 0 (0 = flush every "
+            f"request immediately while degraded), got "
+            f"{self.serve_brownout_wait_ms}")
         assert self.resolved_param_gather_dtype in ("bfloat16", "float32"), (
             f"unknown param_gather_dtype {self.param_gather_dtype!r}")
         assert self.grad_reduce_dtype in ("bfloat16", "float32"), (
@@ -673,6 +708,29 @@ def build_parser() -> argparse.ArgumentParser:
                        help="seconds a /predict handler waits on its batch "
                             "future before answering 503 (> 0; surfaced in "
                             "/metrics)")
+    serve.add_argument("--serve_brownout_enter_frac", type=float,
+                       default=0.75,
+                       help="brownout trigger: queue depth sustained at or "
+                            "above this fraction of --serve_queue_max for "
+                            "--serve_brownout_dwell_s enters degraded mode "
+                            "(topk clamped to 1, batcher deadline shortened, "
+                            "degraded: true in /healthz; 0 = off)")
+    serve.add_argument("--serve_brownout_exit_frac", type=float, default=0.25,
+                       help="hysteretic brownout recovery: depth sustained "
+                            "at or below this fraction for the dwell exits "
+                            "degraded mode (must be <= the enter fraction)")
+    serve.add_argument("--serve_brownout_dwell_s", type=float, default=2.0,
+                       help="sustained-pressure window for both brownout "
+                            "transitions — blips shorter than this never "
+                            "flip the mode")
+    serve.add_argument("--serve_brownout_wait_ms", type=float, default=1.0,
+                       help="degraded-mode batcher flush deadline, replacing "
+                            "--max_batch_wait_ms while browned out")
+    serve.add_argument("--serve_allow_chaos", action="store_true",
+                       dest="serve_allow_chaos",
+                       help="arm POST /chaos (accepts a vitax/faults.py "
+                            "plan JSON body, installed live) for chaos "
+                            "drills — never enable in production")
     return parser
 
 

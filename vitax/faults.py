@@ -31,6 +31,30 @@ Sites (each a single host-side hook point; see the wiring modules):
               — `oserror` drills the missing/corrupted-buddy fallback to
               the last committed Orbax epoch
 
+Serve-path sites (the chaos layer for vitax/serve/ — same deterministic
+per-site index semantics; a plan forwarded to a replica via --fault_plan
+scripts replica crash/hang/slow-response/flaky-health scenarios):
+  engine_predict
+              once per InferenceEngine.predict call (vitax/serve/engine.py)
+              — `hang` is a stuck accelerator, `crash` an OOM-killed
+              replica mid-request
+  batcher_flush
+              once per DynamicBatcher flush (vitax/serve/batcher.py), on
+              the batcher worker thread — `hang` stalls every request in
+              the batch (the predict-hang drill), `oserror` fails the
+              batch (delivered to each request future)
+  replica_health
+              once per ReplicaManager healthz probe, in the ROUTER process
+              (vitax/serve/fleet/replica.py _poll_replica) — `oserror`
+              makes one probe fail, so windows of them drill the
+              flaky-health ejection/re-admission path. Probes sweep the
+              fleet in registration order, so with N replicas index
+              k*N + i targets replica i (1-based)
+  router_dispatch
+              once per router dispatch attempt (vitax/serve/fleet/
+              router.py) — `oserror` drills the retry/breaker/budget path
+              without needing a sick replica
+
 Actions:
   crash    os._exit(exit_code) — a hard kill: no atexit, no drains, exactly
            what a segfault/OOM-kill leaves behind (default exit code 13)
@@ -73,7 +97,10 @@ import time
 from typing import Callable, Dict, List, Optional
 
 SITES = ("step", "ckpt_write", "loader", "stream_read", "barrier_timeout",
-         "peer_restore")
+         "peer_restore",
+         # serve-path chaos sites (the serving sibling of the train hooks)
+         "engine_predict", "batcher_flush", "replica_health",
+         "router_dispatch")
 ACTIONS = ("crash", "hang", "oserror", "stall", "sigterm", "peer_loss")
 
 DEFAULT_CRASH_EXIT_CODE = 13

@@ -22,6 +22,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from vitax import faults
+
 
 class QueueFull(RuntimeError):
     """submit() against a batcher whose pending queue is at queue_max.
@@ -103,6 +105,16 @@ class DynamicBatcher:
         with self._cond:
             return len(self._pending)
 
+    def set_max_wait_ms(self, max_wait_ms: float) -> None:
+        """Retune the flush deadline at runtime (brownout mode shortens it
+        to drain the queue faster, then restores it on recovery). The worker
+        recomputes its deadline from `max_wait_s` every cycle, so the new
+        value takes effect at the next flush decision."""
+        assert max_wait_ms >= 0, max_wait_ms
+        with self._cond:
+            self.max_wait_s = max_wait_ms / 1000.0
+            self._cond.notify()
+
     def close(self, timeout: float = 10.0) -> None:
         """Stop accepting work, flush what is queued, join the worker."""
         with self._cond:
@@ -120,10 +132,11 @@ class DynamicBatcher:
                 if not self._pending and self._closed:
                     return
                 # flush when the largest bucket fills or the OLDEST request
-                # hits the deadline, whichever first
-                deadline = self._pending[0][2] + self.max_wait_s
+                # hits the deadline, whichever first (deadline recomputed
+                # each wait so set_max_wait_ms() applies to queued work too)
                 while (len(self._pending) < self.max_batch
                        and not self._closed):
+                    deadline = self._pending[0][2] + self.max_wait_s
                     remaining = deadline - time.time()
                     if remaining <= 0:
                         break
@@ -137,6 +150,10 @@ class DynamicBatcher:
         images = np.stack([img for img, _, _ in batch])
         t_flush = time.time()
         try:
+            # chaos hook on the worker thread: `hang` stalls the whole batch
+            # (the predict-hang drill), `oserror` fails it — delivered to
+            # every request future below, never killing the worker
+            faults.fire("batcher_flush")
             ids, probs = self.predict_fn(images)
         except Exception as e:  # noqa: BLE001 — deliver, don't kill the worker
             for _, fut, _ in batch:

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from vitax import faults
 from vitax.config import Config
 from vitax.parallel.mesh import BATCH_AXES, Mesh, batch_pspec, build_mesh
 from vitax.utils.logging import master_print
@@ -210,6 +211,7 @@ class InferenceEngine:
         top-k probs (n, k) float32). Pads to the next bucket; the padded
         rows' outputs are discarded. Only precompiled buckets execute —
         an unseen shape raises instead of silently recompiling."""
+        faults.fire("engine_predict")
         n = images.shape[0]
         bucket = next_bucket(n, self.buckets)
         assert bucket in self._compiled, (

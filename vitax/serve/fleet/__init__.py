@@ -13,6 +13,9 @@ Three layers, bottom up:
                  vitax.supervise seams;
 - router.py    — Router + stdlib HTTP front door: least-loaded dispatch,
                  one retry on a different replica, fleet-wide /metrics;
+- breaker.py   — CircuitBreaker (per-replica closed/open/half-open over
+                 consecutive dispatch failures) + RetryBudget (token
+                 bucket capping retries+hedges at a fraction of traffic);
 - admission.py — AdmissionController: predicted-wait 429 shedding with
                  Retry-After against the --slo_p99_ms deadline.
 
@@ -21,6 +24,10 @@ pins the rotation, retry, and overload behaviors.
 """
 
 from vitax.serve.fleet.admission import AdmissionController  # noqa: F401
+from vitax.serve.fleet.breaker import (  # noqa: F401
+    CircuitBreaker,
+    RetryBudget,
+)
 from vitax.serve.fleet.replica import (  # noqa: F401
     DEAD,
     EJECTED,

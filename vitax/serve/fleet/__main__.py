@@ -28,6 +28,9 @@ from vitax.config import Config, build_parser, config_fields_from_namespace
 _FLEET_ONLY_FLAGS = (
     "--replicas", "--base_port", "--slo_p99_ms", "--health_interval_s",
     "--fail_threshold", "--replica_max_restarts",
+    # router-side failure containment (vitax/serve/fleet/breaker.py):
+    "--breaker_threshold", "--breaker_cooldown_s", "--retry_budget_ratio",
+    "--hedge_after_ms",
     # replica-specific overrides the fleet re-issues per replica:
     "--serve_port", "--metrics_dir",
 )
@@ -90,6 +93,23 @@ def main(argv=None) -> int:
     fleet.add_argument("--replica_max_restarts", type=int, default=10,
                        help="restarts-with-backoff per replica before the "
                             "fleet gives up on it")
+    fleet.add_argument("--breaker_threshold", type=int, default=3,
+                       help="consecutive dispatch failures that trip a "
+                            "replica's circuit breaker open (half-open "
+                            "single-probe re-admission after the cooldown)")
+    fleet.add_argument("--breaker_cooldown_s", type=float, default=2.0,
+                       help="seconds an open breaker waits before admitting "
+                            "its half-open probe dispatch")
+    fleet.add_argument("--retry_budget_ratio", type=float, default=0.1,
+                       help="retry/hedge token earned per dispatched "
+                            "request: caps retries at this fraction of "
+                            "recent traffic so a dying fleet degrades to "
+                            "fast 503s, not a retry storm (0 = unlimited)")
+    fleet.add_argument("--hedge_after_ms", type=float, default=0.0,
+                       help="opt-in hedged requests: when the first attempt "
+                            "exceeds max(this, rolling p99), fire a second "
+                            "attempt on another replica — first response "
+                            "wins, bounded by the retry budget (0 = off)")
     ns = parser.parse_args(argv)
     cfg = Config(**config_fields_from_namespace(ns)).validate()
     assert ns.replicas >= 1, f"--replicas must be >= 1, got {ns.replicas}"
@@ -101,11 +121,20 @@ def main(argv=None) -> int:
     from vitax.serve.fleet.router import Router, start_router, stop_router
 
     recorder = build_serve_recorder(cfg)
+    # arm the chaos layer in THIS process too: the replica_health and
+    # router_dispatch fault sites live router-side (--fault_plan is also
+    # forwarded to every replica for the engine/batcher sites)
+    import os
+    from vitax import faults
+    if cfg.fault_plan or os.environ.get(faults.ENV_VAR, ""):
+        faults.install_from_config(cfg)
+        if recorder is not None:
+            faults.set_reporter(
+                lambda p: recorder.event("serve_fault", **p))
     manager = ReplicaManager(
         recorder=recorder, health_interval_s=ns.health_interval_s,
         fail_threshold=ns.fail_threshold,
         max_restarts=ns.replica_max_restarts)
-    import os
     for i in range(ns.replicas):
         port = base_port + i
         metrics_dir = (os.path.join(cfg.metrics_dir, f"replica_{i}")
@@ -116,7 +145,11 @@ def main(argv=None) -> int:
 
     admission = AdmissionController(ns.slo_p99_ms, recorder=recorder)
     router = Router(manager, admission=admission, recorder=recorder,
-                    request_timeout_s=cfg.serve_request_timeout_s)
+                    request_timeout_s=cfg.serve_request_timeout_s,
+                    breaker_threshold=ns.breaker_threshold,
+                    breaker_cooldown_s=ns.breaker_cooldown_s,
+                    retry_budget_ratio=ns.retry_budget_ratio,
+                    hedge_after_ms=ns.hedge_after_ms)
     httpd = start_router(router, cfg.serve_port)
     print(f"fleet: router on :{httpd.server_address[1]}, {ns.replicas} "
           f"replicas on :{base_port}..:{base_port + ns.replicas - 1} "

@@ -1,0 +1,227 @@
+"""Per-replica circuit breaker + token-bucket retry budget for the router.
+
+Two containment mechanisms the dispatch path (vitax/serve/fleet/router.py)
+composes on top of the manager's health ejection — which only sees
+`/healthz`, so a replica that answers health probes but fails every
+dispatch (wedged batcher, hung accelerator) stays in rotation forever
+without them:
+
+- **CircuitBreaker** (one per replica): closed -> open after
+  `fail_threshold` CONSECUTIVE dispatch failures; while open, the router
+  skips the replica entirely (no connection attempt, no timeout burned).
+  After `cooldown_s` the breaker admits exactly ONE probe dispatch
+  (half-open): success re-closes it, failure re-opens it for another
+  cooldown. The closed path is a single lock-guarded state check — no
+  dispatch latency when healthy.
+
+- **RetryBudget** (one per router): gRPC-style token bucket capping
+  retries + hedges at a fraction of recent request volume. Every
+  dispatched request deposits `ratio` tokens (bucket capped at `cap`);
+  every retry or hedge withdraws one whole token. When the fleet is dying
+  and every request wants a retry, the bucket drains and the router
+  degrades to FAST 503s instead of multiplying the load it cannot serve
+  (the retry-storm anti-pattern). `ratio <= 0` disables the budget
+  (every withdraw granted — the pre-budget behavior).
+
+Stdlib-only and jax-free, like the rest of the router tier. Telemetry:
+state transitions surface through the `on_event` callback as
+`kind:"breaker"` events; counters fold into the router's /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+# breaker states
+CLOSED = "closed"          # healthy: dispatches flow
+OPEN = "open"              # tripped: no dispatches until cooldown elapses
+HALF_OPEN = "half_open"    # cooldown over: exactly one probe in flight
+
+DEFAULT_FAIL_THRESHOLD = 3
+DEFAULT_COOLDOWN_S = 2.0
+DEFAULT_BUDGET_RATIO = 0.1
+DEFAULT_BUDGET_CAP = 10.0
+
+
+class CircuitBreaker:
+    """Closed/open/half-open state machine over consecutive dispatch
+    failures. Thread-safe: handler threads record outcomes concurrently."""
+
+    def __init__(self, name: str,
+                 fail_threshold: int = DEFAULT_FAIL_THRESHOLD,
+                 cooldown_s: float = DEFAULT_COOLDOWN_S,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_event: Optional[Callable[[dict], None]] = None):
+        assert fail_threshold >= 1, fail_threshold
+        assert cooldown_s >= 0, cooldown_s
+        self.name = name
+        self.fail_threshold = fail_threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._open_until = 0.0
+        self._probe_in_flight = False
+        self.opens_total = 0      # closed -> open trips
+        self.reopens_total = 0    # failed half-open probes
+        self.closes_total = 0     # successful re-admissions
+
+    # -- dispatch-side API ---------------------------------------------------
+
+    def eligible(self) -> bool:
+        """May a dispatch be SENT here now? Pure check, no reservation —
+        the router uses it to filter replica selection. Closed: always.
+        Open: only once the cooldown elapsed (the would-be probe).
+        Half-open: only while the single probe slot is free."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return self._clock() >= self._open_until
+            return not self._probe_in_flight
+
+    def begin(self) -> bool:
+        """Reserve the dispatch just picked for this replica. Closed: free.
+        Open past cooldown: transition to half-open and claim the single
+        probe slot. False means another thread won the probe race (or the
+        breaker is still cooling down) — the caller must pick elsewhere."""
+        event = None
+        with self._lock:
+            if self._state == CLOSED:
+                ok = True
+            elif self._state == OPEN:
+                if self._clock() >= self._open_until:
+                    self._state = HALF_OPEN
+                    self._probe_in_flight = True
+                    event = {"event": "half_open"}
+                    ok = True
+                else:
+                    ok = False
+            else:  # HALF_OPEN
+                ok = not self._probe_in_flight
+                if ok:
+                    self._probe_in_flight = True
+        if event is not None:
+            self._emit(event)
+        return ok
+
+    def release_unused(self) -> None:
+        """Hand back a begin() reservation without an outcome (the picked
+        replica was never dispatched to — e.g. hedge bookkeeping)."""
+        with self._lock:
+            self._probe_in_flight = False
+
+    def record_success(self) -> None:
+        event = None
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._state = CLOSED
+                self._probe_in_flight = False
+                self.closes_total += 1
+                event = {"event": "close"}
+        if event is not None:
+            self._emit(event)
+
+    def record_failure(self) -> None:
+        event = None
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # the probe failed: back to open for another cooldown
+                self._state = OPEN
+                self._probe_in_flight = False
+                self._open_until = self._clock() + self.cooldown_s
+                self.reopens_total += 1
+                event = {"event": "reopen"}
+            elif self._state == CLOSED:
+                self._consecutive_failures += 1
+                if self._consecutive_failures >= self.fail_threshold:
+                    self._state = OPEN
+                    self._open_until = self._clock() + self.cooldown_s
+                    self.opens_total += 1
+                    event = {"event": "open",
+                             "failures": self._consecutive_failures}
+            # OPEN: a straggler failure from a pre-trip dispatch — no-op
+        if event is not None:
+            self._emit(event)
+
+    # -- observability -------------------------------------------------------
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "opens_total": self.opens_total,
+                "reopens_total": self.reopens_total,
+                "closes_total": self.closes_total,
+            }
+
+    def _emit(self, payload: dict) -> None:
+        # outside the lock: the telemetry sink must never block transitions
+        if self._on_event is not None:
+            try:
+                self._on_event({"replica": self.name, **payload})
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] telemetry must not kill dispatch
+                pass
+
+
+class RetryBudget:
+    """Token bucket bounding retries + hedges to a fraction of traffic."""
+
+    def __init__(self, ratio: float = DEFAULT_BUDGET_RATIO,
+                 cap: float = DEFAULT_BUDGET_CAP):
+        assert ratio >= 0, ratio
+        assert cap >= 1, cap
+        self.ratio = ratio
+        self.cap = float(cap)
+        self._lock = threading.Lock()
+        # starts full: a cold router can absorb a startup blip's retries
+        self._tokens = float(cap)
+        self.deposits_total = 0
+        self.granted_total = 0
+        self.exhausted_total = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.ratio > 0
+
+    def deposit(self) -> None:
+        """One dispatched request earns `ratio` tokens of future retry."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.deposits_total += 1
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def withdraw(self) -> bool:
+        """Spend one token to retry/hedge; False = budget exhausted, the
+        caller must fail fast (503) instead of amplifying load."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.granted_total += 1
+                return True
+            self.exhausted_total += 1
+            return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ratio": self.ratio,
+                "cap": self.cap,
+                "tokens": round(self._tokens, 3),
+                "deposits_total": self.deposits_total,
+                "granted_total": self.granted_total,
+                "exhausted_total": self.exhausted_total,
+            }

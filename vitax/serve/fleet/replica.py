@@ -33,6 +33,7 @@ Stdlib-only by design: the router tier must run on a box with no jax.
 from __future__ import annotations
 
 import json
+import random
 import subprocess
 import sys
 import threading
@@ -40,6 +41,7 @@ import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Sequence
 
+from vitax import faults
 from vitax.supervise import backoff_delay, terminate_child
 
 # rotation states
@@ -56,6 +58,7 @@ DEFAULT_BACKOFF_MAX_S = 30.0
 DEFAULT_MAX_RESTARTS = 10
 DEFAULT_TERM_GRACE_S = 30.0
 DEFAULT_EWMA_ALPHA = 0.2
+DEFAULT_HEALTH_JITTER = 0.2  # +-20% per-sweep jitter on the health interval
 
 
 def http_get_json(url: str, timeout: float) -> dict:
@@ -119,12 +122,15 @@ class ReplicaManager:
                  max_restarts: int = DEFAULT_MAX_RESTARTS,
                  term_grace_s: float = DEFAULT_TERM_GRACE_S,
                  ewma_alpha: float = DEFAULT_EWMA_ALPHA,
+                 health_jitter: float = DEFAULT_HEALTH_JITTER,
                  spawn: Optional[Callable] = None,
                  http_get: Optional[Callable[[str, float], dict]] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None):
         assert fail_threshold >= 1, fail_threshold
         assert max_restarts >= 0, max_restarts
+        assert 0.0 <= health_jitter < 1.0, health_jitter
         self.recorder = recorder
         self.health_interval_s = health_interval_s
         self.health_timeout_s = health_timeout_s
@@ -134,6 +140,8 @@ class ReplicaManager:
         self.max_restarts = max_restarts
         self.term_grace_s = term_grace_s
         self.ewma_alpha = ewma_alpha
+        self.health_jitter = health_jitter
+        self._rng = rng or random.Random()
         self.replicas: List[Replica] = []
         self.restart_total = 0
         self.started = time.time()
@@ -183,6 +191,22 @@ class ReplicaManager:
         with self._lock:
             return sum(r.in_flight for r in self.replicas)
 
+    def degraded_count(self) -> int:
+        """Replicas whose last /healthz advertised brownout (degraded:
+        true) — serving, but shedding optional work. The router folds this
+        into the fleet aggregate."""
+        with self._lock:
+            return sum(1 for r in self.replicas
+                       if bool(r.last_health.get("degraded")))
+
+    def degraded_seconds(self) -> float:
+        """Fleet-wide brownout time: sum of each replica's advertised
+        degraded_seconds (its BrownoutController odometer) at last poll."""
+        with self._lock:
+            return round(sum(
+                float(r.last_health.get("degraded_seconds") or 0.0)
+                for r in self.replicas), 3)
+
     def acquire(self, exclude: Sequence[str] = ()) -> Optional[Replica]:
         """Least-loaded pick: the READY replica with the fewest in-flight
         requests, ties broken by EWMA latency. Increments its in-flight
@@ -198,9 +222,15 @@ class ReplicaManager:
             return best
 
     def release(self, replica: Replica, latency_s: Optional[float] = None,
-                ok: bool = True) -> None:
+                ok: bool = True, counted: bool = True) -> None:
+        """Pair of acquire(). `counted=False` undoes the acquire without
+        charging a success or failure (the router's breaker uses it when it
+        returns a picked replica unused — e.g. losing a half-open probe
+        race — so accounting reflects only real dispatches)."""
         with self._lock:
             replica.in_flight = max(replica.in_flight - 1, 0)
+            if not counted:
+                return
             if ok:
                 replica.requests_total += 1
                 if latency_s is not None:
@@ -230,6 +260,10 @@ class ReplicaManager:
                 self._handle_dead(r, rc, now)
                 return
         try:
+            # chaos hook: `oserror` here is one flaky probe — probes sweep
+            # the fleet in registration order, so with N replicas index
+            # k*N + i targets replica i deterministically
+            faults.fire("replica_health")
             payload = self._http_get(r.url + "/healthz",
                                      self.health_timeout_s)
             live = payload.get("status") == "ok"
@@ -304,8 +338,19 @@ class ReplicaManager:
                                         name="vitax-fleet-health")
         self._thread.start()
 
+    def _next_interval(self) -> float:
+        """Jittered sleep before the next health sweep: uniform in
+        health_interval_s * [1 - jitter, 1 + jitter]. Without jitter every
+        manager in a deployment polls on the same cadence and a slow fleet
+        sees synchronized probe bursts (a thundering herd against replicas
+        already struggling to answer)."""
+        if self.health_jitter <= 0.0:
+            return self.health_interval_s
+        spread = self.health_jitter * (2.0 * self._rng.random() - 1.0)
+        return self.health_interval_s * (1.0 + spread)
+
     def _loop(self) -> None:
-        while not self._stop.wait(timeout=self.health_interval_s):
+        while not self._stop.wait(timeout=self._next_interval()):
             try:
                 self.poll_once()
             except Exception as e:  # noqa: BLE001 — health loop must survive

@@ -11,13 +11,32 @@ fleet behaviors on top:
   socket timeout) is retried once on a DIFFERENT replica — /predict is
   idempotent, so the retry is safe and hides single-replica deaths from
   clients;
+- **circuit breakers** (vitax/serve/fleet/breaker.py): per-replica
+  closed -> open after `breaker_threshold` consecutive dispatch failures,
+  half-open single-probe re-admission after `breaker_cooldown_s`. Distinct
+  from the manager's health ejection, which only sees /healthz — the
+  breaker sees actual dispatches, so a replica that answers health probes
+  but fails every request is still contained;
+- **retry budget**: retries and hedges spend a token bucket refilled at
+  `retry_budget_ratio` per request, so a dying fleet degrades to fast
+  503s (reason "retry_budget_exhausted") instead of a retry storm;
+- **hedged requests** (opt-in, `--hedge_after_ms`): when the first attempt
+  exceeds max(hedge_after_ms, rolling p99), a second attempt fires on a
+  DIFFERENT replica; first response wins, the loser is ignored (its
+  thread still releases its in-flight slot). Hedges draw from the same
+  retry budget;
 - **admission control**: before dispatch, the AdmissionController predicts
   this request's queue delay; over-deadline arrivals get 429 +
   Retry-After (see admission.py). A replica's own queue-full 503 is
   mapped to the same 429 shed — backpressure composes up the stack;
 - **fleet metrics**: GET /metrics aggregates router-side p50/p95/p99 and
   per-replica rotation/load state, folding in each ready replica's own
-  /metrics, so one scrape shows the whole fleet.
+  /metrics (including its brownout `degraded` flag), breaker states, and
+  retry-budget counters, so one scrape shows the whole fleet.
+
+Chaos: the `router_dispatch` fault site (vitax/faults.py) fires once per
+dispatch attempt, so the retry/breaker/budget paths are drillable without
+a sick replica.
 
 Stdlib-only and jax-free: the router runs on a box with no accelerator.
 """
@@ -25,15 +44,21 @@ Stdlib-only and jax-free: the router runs on a box with no accelerator.
 from __future__ import annotations
 
 import json
+import queue as queue_mod
 import threading
 import time
 import urllib.error
 import urllib.request
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
+from vitax import faults
 from vitax.serve.fleet.admission import AdmissionController
+from vitax.serve.fleet.breaker import (CircuitBreaker, RetryBudget,
+                                       DEFAULT_BUDGET_RATIO,
+                                       DEFAULT_COOLDOWN_S,
+                                       DEFAULT_FAIL_THRESHOLD)
 from vitax.serve.fleet.replica import ReplicaManager
 
 DISPATCH_ATTEMPTS = 2  # first pick + one retry on a different replica
@@ -59,6 +84,8 @@ class RouterMetrics:
         self.errors_total = 0
         self.shed_total = 0
         self.retries_total = 0
+        self.hedges_total = 0
+        self.hedge_wins_total = 0
         self._latency = deque(maxlen=window)
         self._times = deque(maxlen=window)
 
@@ -80,12 +107,27 @@ class RouterMetrics:
         with self._lock:
             self.retries_total += 1
 
+    def hedge(self) -> None:
+        with self._lock:
+            self.hedges_total += 1
+
+    def hedge_win(self) -> None:
+        with self._lock:
+            self.hedge_wins_total += 1
+
+    def p99(self) -> Optional[float]:
+        """Rolling client-latency p99 — the hedge trigger threshold."""
+        with self._lock:
+            lat = sorted(self._latency)
+        return _percentile(lat, 0.99)
+
     def snapshot(self) -> dict:
         with self._lock:
             lat = sorted(self._latency)
             times = list(self._times)
             total, errors = self.requests_total, self.errors_total
             shed, retries = self.shed_total, self.retries_total
+            hedges, hedge_wins = self.hedges_total, self.hedge_wins_total
         now = time.time()
         recent = [t for t in times if now - t <= 60.0]
         return {
@@ -93,6 +135,8 @@ class RouterMetrics:
             "errors_total": errors,
             "shed_total": shed,
             "retries_total": retries,
+            "hedges_total": hedges,
+            "hedge_wins_total": hedge_wins,
             "uptime_s": round(now - self.started, 3),
             "requests_per_sec": round(total / max(now - self.started, 1e-9), 3),
             "requests_per_sec_60s": round(len(recent) / 60.0, 3),
@@ -108,12 +152,23 @@ class Router:
 
     def __init__(self, manager: ReplicaManager,
                  admission: Optional[AdmissionController] = None,
-                 recorder=None, request_timeout_s: float = 60.0):
+                 recorder=None, request_timeout_s: float = 60.0,
+                 breaker_threshold: int = DEFAULT_FAIL_THRESHOLD,
+                 breaker_cooldown_s: float = DEFAULT_COOLDOWN_S,
+                 retry_budget_ratio: float = DEFAULT_BUDGET_RATIO,
+                 hedge_after_ms: float = 0.0):
+        assert hedge_after_ms >= 0, hedge_after_ms
         self.manager = manager
         self.admission = admission
         self.recorder = recorder
         self.request_timeout_s = request_timeout_s
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.hedge_after_ms = hedge_after_ms
+        self.budget = RetryBudget(ratio=retry_budget_ratio)
         self.metrics = RouterMetrics()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._breaker_lock = threading.Lock()
 
     # -- dispatch --------------------------------------------------------------
 
@@ -135,66 +190,204 @@ class Router:
                 return 429, {"Retry-After": str(retry_after)}, {
                     "error": "shed: predicted wait exceeds the p99 deadline",
                     "reason": "admission"}
-        exclude = set()
+        self.budget.deposit()
+        exclude: set = set()
         for attempt in range(DISPATCH_ATTEMPTS):
-            replica = self.manager.acquire(exclude=exclude)
+            replica = self._pick(exclude)
             if replica is None:
                 break
-            t0 = time.monotonic()
-            try:
-                req = urllib.request.Request(
-                    replica.url + "/predict", data=body,
-                    headers={"Content-Type": content_type or
-                             "application/octet-stream"})
-                with urllib.request.urlopen(
-                        req, timeout=self.request_timeout_s) as resp:
-                    out = resp.read()
-                latency = time.monotonic() - t0
-                self.manager.release(replica, latency_s=latency, ok=True)
-                if self.admission is not None:
-                    self.admission.observe(latency)
-                self.metrics.observe(latency)
-                return 200, {}, out
-            except urllib.error.HTTPError as e:
-                payload = self._json_body(e)
-                if e.code == 503 and payload.get("reason") == "queue_full":
-                    # replica backpressure -> fleet admission shed: clients
-                    # see one uniform overload signal (429 + Retry-After)
-                    self.manager.release(replica, ok=False)
-                    self.metrics.shed()
-                    if self.admission is not None:
-                        self.admission.record_shed(
-                            reason="replica_queue_full", replica=replica.name)
-                    retry_hdr = e.headers.get("Retry-After", "1") \
-                        if e.headers else "1"
-                    return 429, {"Retry-After": retry_hdr}, {
-                        "error": "shed: replica queue full",
-                        "reason": "replica_queue_full"}
-                if 400 <= e.code < 500:
-                    # the client's fault (bad image, bad topk): pass the
-                    # replica's verdict through verbatim, never retry
-                    self.manager.release(replica, ok=False)
+            if attempt == 0 and self.hedge_after_ms > 0:
+                outcome = self._attempt_hedged(replica, body, content_type,
+                                               exclude)
+            else:
+                outcome = self._attempt(replica, body, content_type)
+            if outcome["kind"] == "response":
+                return self._finish(outcome)
+            exclude.add(replica.name)
+            self._event("dispatch_retry", replica=replica.name,
+                        attempt=attempt, detail=outcome["detail"])
+            if attempt + 1 < DISPATCH_ATTEMPTS:
+                if not self.budget.withdraw():
+                    # budget dry: fail FAST instead of amplifying load on a
+                    # dying fleet — the anti-retry-storm contract
+                    self._event("retry_budget", event="exhausted",
+                                replica=replica.name)
                     self.metrics.error()
-                    return e.code, {}, payload or {
-                        "error": f"replica answered {e.code}"}
-                self._dispatch_failed(replica, exclude, attempt,
-                                      f"HTTP {e.code}")
-            except Exception as e:  # noqa: BLE001 — refused/timeout/reset
-                self._dispatch_failed(replica, exclude, attempt,
-                                      f"{type(e).__name__}: {e}")
+                    return 503, {"Retry-After": "1"}, {
+                        "error": "retry budget exhausted",
+                        "reason": "retry_budget_exhausted"}
+                self.metrics.retry()
         self.metrics.error()
         return 503, {"Retry-After": "1"}, {
             "error": "dispatch failed on all replicas",
             "reason": "dispatch_failed"}
 
-    def _dispatch_failed(self, replica, exclude: set, attempt: int,
-                         detail: str) -> None:
+    def _breaker(self, name: str) -> CircuitBreaker:
+        with self._breaker_lock:
+            br = self._breakers.get(name)
+            if br is None:
+                br = CircuitBreaker(
+                    name, fail_threshold=self.breaker_threshold,
+                    cooldown_s=self.breaker_cooldown_s,
+                    on_event=lambda p: self._event("breaker", **p))
+                self._breakers[name] = br
+            return br
+
+    def _blocked_names(self) -> set:
+        """Replicas whose breaker currently refuses dispatches. Closed
+        breakers answer eligible() with one lock-guarded state read — the
+        no-fault fast path adds no dispatch latency."""
+        with self._breaker_lock:
+            items = list(self._breakers.items())
+        return {name for name, br in items if not br.eligible()}
+
+    def _pick(self, exclude: set):
+        """Least-loaded READY replica whose breaker admits a dispatch, with
+        the breaker reservation (half-open single probe) taken."""
+        skip = set(exclude)
+        while True:
+            replica = self.manager.acquire(exclude=skip | self._blocked_names())
+            if replica is None:
+                return None
+            if self._breaker(replica.name).begin():
+                return replica
+            # lost a half-open probe race: hand the slot back uncharged
+            self.manager.release(replica, counted=False)
+            skip.add(replica.name)
+
+    def _attempt(self, replica, body: bytes, content_type: str) -> dict:
+        """One dispatch to one replica (breaker reservation already held).
+        Returns {"kind": "response", ...} for anything the client should
+        see (200/429/4xx) or {"kind": "failed", "detail": ...} when the
+        attempt should be retried elsewhere. Per-attempt accounting
+        (release, breaker, admission EWMA) happens here; per-REQUEST
+        counters happen once in _finish() so hedges never double-count."""
+        breaker = self._breaker(replica.name)
+        t0 = time.monotonic()
+        try:
+            faults.fire("router_dispatch")
+            req = urllib.request.Request(
+                replica.url + "/predict", data=body,
+                headers={"Content-Type": content_type or
+                         "application/octet-stream"})
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                out = resp.read()
+            latency = time.monotonic() - t0
+            self.manager.release(replica, latency_s=latency, ok=True)
+            breaker.record_success()
+            if self.admission is not None:
+                self.admission.observe(latency)
+            return {"kind": "response", "status": 200, "headers": {},
+                    "payload": out, "latency": latency,
+                    "replica": replica.name}
+        except urllib.error.HTTPError as e:
+            payload = self._json_body(e)
+            if e.code == 503 and payload.get("reason") == "queue_full":
+                # replica backpressure -> fleet admission shed: clients
+                # see one uniform overload signal (429 + Retry-After).
+                # The replica answered, so the breaker counts a success.
+                self.manager.release(replica, ok=False)
+                breaker.record_success()
+                retry_hdr = e.headers.get("Retry-After", "1") \
+                    if e.headers else "1"
+                return {"kind": "response", "status": 429,
+                        "headers": {"Retry-After": retry_hdr},
+                        "payload": {"error": "shed: replica queue full",
+                                    "reason": "replica_queue_full"},
+                        "shed": True, "replica": replica.name}
+            if 400 <= e.code < 500:
+                # the client's fault (bad image, bad topk): pass the
+                # replica's verdict through verbatim, never retry
+                self.manager.release(replica, ok=False)
+                breaker.record_success()
+                return {"kind": "response", "status": e.code, "headers": {},
+                        "payload": payload or {
+                            "error": f"replica answered {e.code}"},
+                        "client_error": True, "replica": replica.name}
+            detail = f"HTTP {e.code}"
+        except Exception as e:  # noqa: BLE001 — refused/timeout/reset
+            detail = f"{type(e).__name__}: {e}"
         self.manager.release(replica, ok=False)
-        exclude.add(replica.name)
-        if attempt + 1 < DISPATCH_ATTEMPTS:
-            self.metrics.retry()
-        self._event("dispatch_retry", replica=replica.name, attempt=attempt,
-                    detail=detail)
+        breaker.record_failure()
+        return {"kind": "failed", "detail": detail, "replica": replica.name}
+
+    def _hedge_delay_s(self) -> float:
+        """Hedge trigger: the rolling p99, floored at --hedge_after_ms (the
+        floor keeps a cold window from hedging every request)."""
+        p99 = self.metrics.p99()
+        return max(p99 or 0.0, self.hedge_after_ms / 1000.0)
+
+    def _attempt_hedged(self, primary, body: bytes, content_type: str,
+                        exclude: set) -> dict:
+        """First attempt with a hedge: if `primary` has not answered within
+        the hedge delay, fire the same request at a second replica (budget
+        permitting); first response wins, the loser is ignored — its
+        worker thread still runs _attempt's release/breaker accounting."""
+        results: queue_mod.Queue = queue_mod.Queue()
+
+        def run(replica, is_hedge: bool) -> None:
+            out = self._attempt(replica, body, content_type)
+            out["hedge"] = is_hedge
+            results.put(out)
+
+        threading.Thread(  # vtx: ignore[VTX205] fire-and-forget: loser self-accounts in _attempt, result abandoned
+            target=run, args=(primary, False), daemon=True,
+            name="vitax-router-hedge-primary").start()
+        launched = 1
+        got: list = []
+        try:
+            got.append(results.get(timeout=self._hedge_delay_s()))
+        except queue_mod.Empty:
+            # primary is slow past the threshold: hedge on another replica,
+            # bounded by the same retry budget as plain retries
+            if self.budget.withdraw():
+                hedge_replica = self._pick(set(exclude) | {primary.name})
+                if hedge_replica is not None:
+                    self.metrics.hedge()
+                    self._event("hedge", event="fired", primary=primary.name,
+                                replica=hedge_replica.name)
+                    threading.Thread(  # vtx: ignore[VTX205] fire-and-forget: see the primary-attempt thread above
+                        target=run, args=(hedge_replica, True), daemon=True,
+                        name="vitax-router-hedge-secondary").start()
+                    launched = 2
+        # first RESPONSE wins; a failed attempt keeps waiting on the other
+        deadline = time.monotonic() + self.request_timeout_s + 1.0
+        while (len(got) < launched
+               and not any(o["kind"] == "response" for o in got)):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                got.append(results.get(timeout=remaining))
+            except queue_mod.Empty:
+                break
+        winner = next((o for o in got if o["kind"] == "response"), None)
+        if winner is not None:
+            if winner.get("hedge"):
+                self.metrics.hedge_win()
+                self._event("hedge", event="win", replica=winner["replica"])
+            return winner
+        for o in got:
+            exclude.add(o["replica"])
+        details = "; ".join(o["detail"] for o in got)
+        return {"kind": "failed", "replica": primary.name,
+                "detail": details or "hedged attempts timed out"}
+
+    def _finish(self, outcome: dict) -> Tuple[int, dict, object]:
+        """Per-request bookkeeping, exactly once per client response (the
+        losing side of a hedge never reaches here)."""
+        status = outcome["status"]
+        if status == 200:
+            self.metrics.observe(outcome["latency"])
+        elif outcome.get("shed"):
+            self.metrics.shed()
+            if self.admission is not None:
+                self.admission.record_shed(reason="replica_queue_full",
+                                           replica=outcome["replica"])
+        else:
+            self.metrics.error()
+        return status, outcome["headers"], outcome["payload"]
 
     @staticmethod
     def _json_body(e: urllib.error.HTTPError) -> dict:
@@ -232,8 +425,18 @@ class Router:
             "ready": self.manager.ready_count(),
             "in_flight": self.manager.total_in_flight(),
             "replica_restarts": self.manager.restart_total,
+            # brownout visibility: replicas advertising degraded: true in
+            # their last /healthz (serving, but shedding optional work)
+            "degraded": self.manager.degraded_count(),
+            "degraded_seconds": self.manager.degraded_seconds(),
         }
         snap["replicas"] = replicas
+        with self._breaker_lock:
+            breakers = list(self._breakers.items())
+        snap["breakers"] = {name: br.snapshot() for name, br in breakers}
+        snap["breaker_opens"] = sum(
+            br.opens_total + br.reopens_total for _, br in breakers)
+        snap["retry_budget"] = self.budget.snapshot()
         if self.admission is not None:
             snap["admission"] = self.admission.snapshot()
         return snap

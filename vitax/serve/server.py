@@ -20,10 +20,25 @@ Overload and shutdown semantics:
 - a full batcher queue (--serve_queue_max) answers 503 with JSON reason
   "queue_full" and Retry-After — the fleet router maps that to an
   admission shed (429);
+- **brownout** (BrownoutController): queue depth sustained at or above
+  --serve_brownout_enter_frac of --serve_queue_max for
+  --serve_brownout_dwell_s enters DEGRADED mode — optional work is shed
+  (topk clamped to 1, the batcher deadline shortened to
+  --serve_brownout_wait_ms so queued work drains in smaller waits) and
+  /healthz + /metrics advertise `degraded: true` (the fleet router folds
+  the count into its aggregate). Recovery is hysteretic: depth must hold
+  at or below --serve_brownout_exit_frac for the same dwell. Degraded is
+  NOT unready — a browned-out replica still serves;
 - SIGTERM drains gracefully (python -m vitax.serve): stop accepting new
   work (ready: false, new /predict -> 503), answer every in-flight
   request, flush the batcher, exit 0 — so a ReplicaManager restart never
   drops an accepted request.
+
+Chaos: --fault_plan (or VITAX_FAULT_PLAN) arms the serve fault sites
+(vitax/faults.py: engine_predict, batcher_flush) at startup; with
+--serve_allow_chaos, POST /chaos installs a plan into a RUNNING replica
+(tools/serve_bench.py --chaos drives this). Fired faults surface as
+kind:"serve_fault" telemetry events.
 
 Observability rides the existing vitax.telemetry Recorder/sinks: one
 schema-versioned JSONL record per request (kind "serve_request") plus
@@ -36,14 +51,16 @@ from __future__ import annotations
 import base64
 import io
 import json
+import os
 import signal
 import sys
 import threading
 import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
+from vitax import faults
 from vitax.config import Config
 from vitax.serve.engine import InferenceEngine
 from vitax.serve.batcher import DynamicBatcher, QueueFull
@@ -126,12 +143,99 @@ class ServeMetrics:
         }
 
 
+class BrownoutController:
+    """Hysteretic degraded mode keyed on batcher queue depth.
+
+    Pressure (depth >= enter_depth) sustained for `dwell_s` enters
+    DEGRADED; calm (depth <= exit_depth) sustained for the same dwell
+    exits. The dwell window means blips never flip the mode, and the
+    enter/exit gap means depths between the thresholds hold the current
+    state — the two classic chatter guards composed. `clock` is
+    injectable so tests drive transitions without real time.
+
+    The controller only decides; the owner passes `on_enter`/`on_exit`
+    callbacks for the actual shedding (topk clamp, batcher deadline) and
+    telemetry. Disabled (never degrades) when queue_max or enter_frac
+    is 0."""
+
+    def __init__(self, queue_max: int, enter_frac: float, exit_frac: float,
+                 dwell_s: float,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_enter: Optional[Callable[[], None]] = None,
+                 on_exit: Optional[Callable[[float], None]] = None):
+        self.enabled = queue_max > 0 and enter_frac > 0
+        self.enter_depth = enter_frac * queue_max
+        self.exit_depth = exit_frac * queue_max
+        self.dwell_s = dwell_s
+        self._clock = clock
+        self._on_enter = on_enter
+        self._on_exit = on_exit
+        self._lock = threading.Lock()
+        self.degraded = False
+        self._streak_since: Optional[float] = None  # pressure/calm streak
+        self._entered_at: Optional[float] = None
+        self.enters_total = 0
+        self._degraded_s = 0.0  # accumulated across COMPLETED episodes
+
+    def observe(self, depth: int, now: Optional[float] = None) -> bool:
+        """Feed one queue-depth sample; returns the (possibly updated)
+        degraded state. Called from /predict and /healthz handlers — the
+        health poll keeps recovery moving when traffic stops entirely."""
+        if not self.enabled:
+            return False
+        now = self._clock() if now is None else now
+        transition = None
+        with self._lock:
+            if not self.degraded:
+                if depth >= self.enter_depth:
+                    if self._streak_since is None:
+                        self._streak_since = now
+                    if now - self._streak_since >= self.dwell_s:
+                        self.degraded = True
+                        self.enters_total += 1
+                        self._entered_at = now
+                        self._streak_since = None
+                        transition = ("enter", depth)
+                else:
+                    self._streak_since = None
+            else:
+                if depth <= self.exit_depth:
+                    if self._streak_since is None:
+                        self._streak_since = now
+                    if now - self._streak_since >= self.dwell_s:
+                        self.degraded = False
+                        episode_s = now - (self._entered_at or now)
+                        self._degraded_s += episode_s
+                        self._entered_at = None
+                        self._streak_since = None
+                        transition = ("exit", episode_s)
+                else:
+                    self._streak_since = None
+            degraded = self.degraded
+        # callbacks outside the lock: they touch the batcher and telemetry
+        if transition is not None:
+            kind, arg = transition
+            if kind == "enter" and self._on_enter is not None:
+                self._on_enter()
+            elif kind == "exit" and self._on_exit is not None:
+                self._on_exit(arg)
+        return degraded
+
+    def degraded_seconds(self, now: Optional[float] = None) -> float:
+        """Total time spent degraded, including the live episode."""
+        with self._lock:
+            total = self._degraded_s
+            if self._entered_at is not None:
+                total += (self._clock() if now is None else now) \
+                    - self._entered_at
+            return total
+
+
 def build_serve_recorder(cfg: Config):
     """Recorder writing schema-versioned serve.jsonl records through the
     existing telemetry sinks, or None when --metrics_dir is unset. Fail-soft
     like training telemetry: an unwritable dir disables recording, never
     serving."""
-    import os
     metrics_dir = getattr(cfg, "metrics_dir", "") or ""
     if not metrics_dir:
         return None
@@ -199,6 +303,36 @@ class ServeContext:
             bucket_of=lambda n: next_bucket(n, engine.buckets),
             on_batch=self._record_batch,
             queue_max=getattr(cfg, "serve_queue_max", 0))
+        # brownout: shed optional work under sustained queue pressure
+        # instead of tipping into queue-full sheds (degraded != unready:
+        # a browned-out replica still serves)
+        self.brownout = BrownoutController(
+            queue_max=getattr(cfg, "serve_queue_max", 0),
+            enter_frac=getattr(cfg, "serve_brownout_enter_frac", 0.0),
+            exit_frac=getattr(cfg, "serve_brownout_exit_frac", 0.0),
+            dwell_s=getattr(cfg, "serve_brownout_dwell_s", 2.0),
+            on_enter=self._brownout_enter, on_exit=self._brownout_exit)
+
+    def _brownout_enter(self) -> None:
+        # shorten the flush deadline: under pressure, smaller faster
+        # batches drain the queue instead of waiting out the full deadline
+        self.batcher.set_max_wait_ms(
+            getattr(self.cfg, "serve_brownout_wait_ms", 1.0))
+        if self.recorder is not None:
+            self.recorder.event("brownout", event="enter",
+                                queue_depth=self.batcher.queue_depth())
+
+    def _brownout_exit(self, degraded_s: float) -> None:
+        self.batcher.set_max_wait_ms(self.cfg.max_batch_wait_ms)
+        if self.recorder is not None:
+            self.recorder.event("brownout", event="exit",
+                                degraded_s=round(degraded_s, 6))
+
+    def degraded(self) -> bool:
+        """Current brownout verdict, refreshed with a live depth sample
+        (handlers call this, so /healthz polls keep recovery moving even
+        with zero traffic)."""
+        return self.brownout.observe(self.batcher.queue_depth())
 
     def is_ready(self) -> bool:
         """READY = warmed up and not draining. Distinct from liveness: a
@@ -286,6 +420,9 @@ def _make_handler(ctx: ServeContext):
                     "status": "ok",                 # liveness: we answered
                     "ready": ctx.is_ready(),        # routable: warmed + not draining
                     "draining": ctx.draining,
+                    "degraded": ctx.degraded(),     # brownout: serving, but shedding optional work
+                    "degraded_seconds": round(
+                        ctx.brownout.degraded_seconds(), 3),
                     "buckets": list(ctx.engine.buckets),
                     "topk": ctx.engine.topk,
                     "compile_count": ctx.engine.compile_count,
@@ -299,11 +436,18 @@ def _make_handler(ctx: ServeContext):
                 snap["request_timeout_s"] = ctx.request_timeout_s
                 snap["ready"] = ctx.is_ready()
                 snap["draining"] = ctx.draining
+                snap["degraded"] = ctx.degraded()
+                snap["degraded_seconds"] = round(
+                    ctx.brownout.degraded_seconds(), 3)
+                snap["brownout_enters"] = ctx.brownout.enters_total
                 self._reply(200, snap)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):  # noqa: N802
+            if self.path == "/chaos":
+                self._chaos()
+                return
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -319,6 +463,37 @@ def _make_handler(ctx: ServeContext):
             finally:
                 ctx.exit_request()
 
+        def _chaos(self) -> None:
+            """Install a fault plan into this running replica (the drill
+            transport behind tools/serve_bench.py --chaos). Gated hard on
+            --serve_allow_chaos: an open chaos endpoint on a production
+            replica would be remote code-adjacent sabotage, so without the
+            opt-in the path answers 403 and changes nothing. An empty body
+            disarms."""
+            if not getattr(ctx.cfg, "serve_allow_chaos", False):
+                self._reply(403, {
+                    "error": "chaos endpoint disabled "
+                             "(start with --serve_allow_chaos to arm)"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length).decode("utf-8").strip()
+            if not body:
+                faults.uninstall()
+                self._reply(200, {"installed": None})
+                return
+            try:
+                plan = faults.install(body)
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            if ctx.recorder is not None:
+                rec = ctx.recorder
+                faults.set_reporter(
+                    lambda p: rec.event("serve_fault", **p))
+            if ctx.recorder is not None:
+                ctx.recorder.event("chaos_install", plan=plan.describe())
+            self._reply(200, {"installed": plan.describe()})
+
         def _predict(self) -> None:
             t0 = time.time()
             try:
@@ -330,6 +505,10 @@ def _make_handler(ctx: ServeContext):
                 ctx.metrics.error()
                 self._reply(400, {"error": f"bad request: {e}"})
                 return
+            # brownout: this sample feeds the pressure window, and while
+            # degraded the optional work (top-k beyond 1) is shed
+            if ctx.degraded():
+                topk = 1
             try:
                 fut = ctx.batcher.submit(image)
             except QueueFull as e:
@@ -373,6 +552,13 @@ def start_server(cfg: Config, engine: InferenceEngine,
     port=0 / --serve_port 0 for an ephemeral one — tests do). Call
     `stop_server(httpd, ctx)` to drain and shut down."""
     recorder = build_serve_recorder(cfg)
+    # arm the serve-path chaos sites (engine_predict, batcher_flush) when a
+    # plan is named; left untouched otherwise so embedding tests that
+    # installed a plan directly keep it
+    if getattr(cfg, "fault_plan", "") or os.environ.get(faults.ENV_VAR, ""):
+        faults.install_from_config(cfg)
+    if faults.active() and recorder is not None:
+        faults.set_reporter(lambda p: recorder.event("serve_fault", **p))
     # batcher worker + HTTP handler threads: crashes become thread_crash
     # events in serve.jsonl instead of silent 500s-forever
     install_thread_excepthook(recorder, rank=0)
