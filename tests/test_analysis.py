@@ -24,6 +24,7 @@ from vitax.analysis.rules import (
     GATHER_OVERLAP,
     NO_HOST_TRANSFER,
     NO_REPLICATED_LARGE,
+    QUANT_WEIGHTS_RESIDENT,
     SERVE_NO_RECOMPILE,
     Program,
     arm_config,
@@ -198,6 +199,11 @@ def serve_program(devices8):
     return build_serve_program(arm_config("serve"))
 
 
+@pytest.fixture(scope="session")
+def serve_quant_program(devices8):
+    return build_serve_program(arm_config("serve_quant"), arm="serve_quant")
+
+
 # --- per-rule positive + negative cases --------------------------------------
 
 
@@ -323,12 +329,68 @@ def test_r006_serve_negative(serve_program):
     assert any("accepted an unseen input shape" in m for m in codes)
 
 
-def test_run_rules_dispatch(overlap_program, serve_program):
+def test_r007_quant_resident_positive(serve_quant_program):
+    prog = serve_quant_program
+    assert QUANT_WEIGHTS_RESIDENT.applicable(prog)
+    assert prog.engine.scales, "serve_quant arm must carry quant scales"
+    assert QUANT_WEIGHTS_RESIDENT.check(prog, prog.config) == []
+    # R006 reads the quantized engine too: the AOT contract is dtype-blind
+    assert SERVE_NO_RECOMPILE.check(prog, prog.config) == []
+
+
+def test_r007_not_applicable_without_quant(serve_program):
+    assert not QUANT_WEIGHTS_RESIDENT.applicable(serve_program)
+
+
+def test_r007_quant_resident_negative():
+    import numpy as np
+    cfg = arm_config("serve_quant")
+    d = cfg.embed_dim
+
+    class DequantedEngine:
+        """The violation R007 exists for: the scaled leaf was dequantized at
+        load (f32 on device) and the lowered program takes a block-sized f32
+        weight argument instead of the int8 one."""
+        buckets = (1, 2, 4)
+        scales = {"params/blocks/mlp/fc1/kernel": np.ones((1, 1, d * 4),
+                                                          np.float32)}
+        params = {"params": {"blocks": {"mlp": {"fc1": {
+            "kernel": np.zeros((2, d, d * 4), np.float32)}}}}}
+
+        def lower_bucket_mlir(self, bucket):
+            return mk_mlir([(f"tensor<2x{d}x{d * 4}xf32>", SHARDED),
+                            (f"tensor<4x{cfg.image_size}x{cfg.image_size}"
+                             f"x3xui8>", None)])
+
+    broken = Program(kind="serve", arm="serve_quant", config=cfg,
+                     engine=DequantedEngine())
+    findings = QUANT_WEIGHTS_RESIDENT.check(broken, cfg)
+    msgs = [f.message for f in findings]
+    assert all(f.rule == "VTX-R007" and f.severity == "ERROR"
+               for f in findings)
+    assert any("resident as float32, not int8" in m for m in msgs)
+    assert any("0 i8 arguments for 1 scaled leaves" in m for m in msgs)
+    assert any("block-sized floating argument" in m for m in msgs)
+
+    class UnquantizedEngine(DequantedEngine):
+        scales = {}
+
+    unq = Program(kind="serve", arm="serve_quant", config=cfg,
+                  engine=UnquantizedEngine())
+    findings = QUANT_WEIGHTS_RESIDENT.check(unq, cfg)
+    assert len(findings) == 1
+    assert "no quant scales" in findings[0].message
+
+
+def test_run_rules_dispatch(overlap_program, serve_program,
+                            serve_quant_program):
     ran, findings = rules.run_rules(overlap_program)
     assert ran == ["VTX-R001", "VTX-R002", "VTX-R003", "VTX-R004", "VTX-R005"]
     assert findings == []
     ran_s, findings_s = rules.run_rules(serve_program)
     assert ran_s == ["VTX-R006"] and findings_s == []
+    ran_q, findings_q = rules.run_rules(serve_quant_program)
+    assert ran_q == ["VTX-R006", "VTX-R007"] and findings_q == []
 
 
 def test_comm_audit_reexports():
@@ -361,6 +423,21 @@ def test_check_invariants_json_schema(devices8):
     arm = doc["arms"]["zero3"]
     assert set(arm) == {"ok", "rules_ran", "findings"}
     assert arm["rules_ran"] == ["VTX-R001", "VTX-R002", "VTX-R003", "VTX-R005"]
+    assert arm["findings"] == []
+
+
+def test_check_invariants_serve_quant_arm(devices8):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_invariants.py"),
+         "--arms", "serve_quant", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["errors"] == {}
+    arm = doc["arms"]["serve_quant"]
+    assert set(arm) == {"ok", "rules_ran", "findings"}
+    assert arm["rules_ran"] == ["VTX-R006", "VTX-R007"]
     assert arm["findings"] == []
 
 

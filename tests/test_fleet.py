@@ -139,7 +139,9 @@ class FakeReplica:
                                           "ready": fake.ready})
                 elif self.path == "/metrics":
                     self._reply(200, {"requests_total": fake.predict_count,
-                                      "marker": fake.name})
+                                      "marker": fake.name,
+                                      "weights_dtype": "int8",
+                                      "param_bytes": 1000})
                 else:
                     self._reply(404, {})
 
@@ -593,7 +595,11 @@ def test_fleet_metrics_aggregation(fleet_factory):
         assert snap[key] is not None and snap[key] > 0
     assert snap["fleet"] == {"size": 2, "ready": 2, "in_flight": 0,
                              "replica_restarts": 0, "degraded": 0,
-                             "degraded_seconds": 0.0}
+                             "degraded_seconds": 0.0,
+                             # weight footprint summed over the replicas
+                             # that report it, dtype set for mixed rollouts
+                             "param_bytes": 2000,
+                             "weights_dtypes": ["int8"]}
     assert set(snap["replicas"]) == {"a", "b"}
     total = 0
     for name, rsnap in snap["replicas"].items():

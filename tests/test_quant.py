@@ -1,0 +1,291 @@
+"""Quantized serving end-to-end: consolidate --dtype int8 -> from_npz ->
+bucketed predict, with the CI accuracy gate.
+
+Strategy: one module-scoped stack trains the tiny model for two real steps,
+exports the epoch checkpoint both full-precision and int8-quantized, and
+warms an engine over each. The tests then pin the whole contract ISSUE 14
+ships: manifest schema and skip-set discipline, quantization numerics,
+bitwise-deterministic quantized predictions across loads and bucket sizes,
+the zero-recompile pin on the int8 engine, the >= 45% device-resident byte
+cut, and the quantized-vs-f32 accuracy gate (<= 1.0 top-1 points) with its
+kind:"quant_gate" telemetry event surfaced by tools/metrics_report.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from vitax.config import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the gate evaluates n=256 deterministic samples: one flipped prediction
+# moves top-1 by 0.39 points, so the 1.0-point threshold tolerates two
+# flips before failing — tight enough to catch a broken dequant (which
+# scrambles most predictions), loose enough for round-off flips
+GATE_N = 256
+GATE_MAX_TOP1_DELTA_PTS = 1.0
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        image_size=16, patch_size=8, embed_dim=32, num_heads=2, num_blocks=2,
+        num_classes=4, batch_size=16, dtype="float32", lr=1e-3, warmup_steps=2,
+        serve_max_batch=4, serve_topk=3, max_batch_wait_ms=10.0, seed=0,
+    )
+    base.update(kw)
+    return Config(**base).validate()
+
+
+def gate_batch(cfg, n=GATE_N, seed=11):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(
+        0, 256, size=(n, cfg.image_size, cfg.image_size, 3), dtype=np.uint8)
+    labels = rng.integers(0, cfg.num_classes, size=(n,))
+    return images, labels
+
+
+@pytest.fixture(scope="module")
+def quant_stack(devices8, tmp_path_factory):
+    """(cfg_f32, engine_f32, cfg_int8, engine_int8, f32_path, int8_path)."""
+    from vitax.checkpoint.consolidate import consolidate
+    from vitax.serve import InferenceEngine
+    from vitax.train.loop import train
+
+    root = tmp_path_factory.mktemp("quant")
+    ckpt_dir = str(root / "ckpt")
+    cfg = tiny_cfg(
+        fake_data=True, num_epochs=1, steps_per_epoch=2, log_step_interval=1,
+        ckpt_dir=ckpt_dir, ckpt_epoch_interval=1, num_workers=2,
+        eval_max_batches=1,
+    )
+    train(cfg)  # 2 real optimizer steps; writes epoch_1
+    f32_path = str(root / "f32.npz")
+    int8_path = str(root / "int8.npz")
+    consolidate(ckpt_dir, 1, f32_path)
+    consolidate(ckpt_dir, 1, int8_path, dtype="int8")
+    engine_f = InferenceEngine.from_npz(cfg, f32_path)
+    engine_f.warmup()
+    cfg_q = tiny_cfg(serve_quant_dtype="int8")
+    engine_q = InferenceEngine.from_npz(cfg_q, int8_path)
+    engine_q.warmup()
+    return cfg, engine_f, cfg_q, engine_q, f32_path, int8_path
+
+
+# --- manifest schema and skip discipline ------------------------------------
+
+
+def test_manifest_schema_and_scales(quant_stack):
+    from vitax.checkpoint.consolidate import (
+        QUANT_MANIFEST_KEY, QUANT_SCHEMA_VERSION, load_npz_raw)
+    *_, int8_path = quant_stack
+    flat, scales, manifest = load_npz_raw(int8_path)
+    assert manifest, "int8 export carries no __quant__ manifest"
+    with np.load(int8_path) as data:
+        doc = json.loads(str(data[QUANT_MANIFEST_KEY]))
+    assert doc["schema"] == QUANT_SCHEMA_VERSION
+    assert set(doc["dtypes"]) == {"int8"}  # float8_e4m3 slot stays empty
+    assert doc["dtypes"]["int8"] == sorted(doc["dtypes"]["int8"])
+    for key, dtype in manifest.items():
+        assert dtype == "int8"
+        assert flat[key].dtype == np.int8
+        # keepdims scales: broadcastable against the weight, one scale per
+        # output channel (last axis preserved)
+        s = scales[key]
+        assert s.dtype == np.float32
+        assert s.ndim == flat[key].ndim
+        assert s.shape[-1] == flat[key].shape[-1]
+        np.broadcast_shapes(s.shape, flat[key].shape)
+    # the matmul weights are quantized; LN/bias leaves are not
+    assert any(k.endswith("/kernel") for k in manifest)
+    assert all("norm" not in k and not k.endswith("bias") for k in manifest)
+
+
+def test_skip_set_tracks_keep_f32_params():
+    """QUANT_SKIP_NAMES is KEEP_F32_PARAMS minus the head: the head kernel
+    is a full matmul weight that dequantizes to f32 at use, so int8 storage
+    does not change where its compute happens. A drift between the two
+    lists is a policy change someone must make deliberately."""
+    from vitax.checkpoint.consolidate import QUANT_SKIP_NAMES
+    from vitax.parallel.sharding import KEEP_F32_PARAMS
+    assert set(QUANT_SKIP_NAMES) == set(KEEP_F32_PARAMS) - {"head"}
+
+
+# --- quantization numerics ---------------------------------------------------
+
+
+def test_quantize_leaf_numerics():
+    from vitax.checkpoint.consolidate import quantize_leaf
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    q, scale = quantize_leaf("a/kernel", w)
+    assert q.dtype == np.int8 and scale.shape == (1, 8)
+    assert np.abs(q).max() <= 127
+    # symmetric round-to-nearest: error bounded by half a quant step/channel
+    err = np.abs(q.astype(np.float32) * scale - w)
+    assert np.all(err <= scale / 2 + 1e-7)
+    # all-zero channels stay representable (scale 1.0, q 0)
+    z = np.zeros((4, 2), np.float32)
+    qz, sz = quantize_leaf("a/kernel", z)
+    assert np.all(qz == 0) and np.all(sz == 1.0)
+
+
+def test_fused_dequant_matmul_matches_f32():
+    from vitax.checkpoint.consolidate import quantize_leaf
+    from vitax.serve.quant import dequantize_leaf, fused_dequant_matmul
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    q, scale = quantize_leaf("a/kernel", w)
+    out = np.asarray(fused_dequant_matmul(x, q, scale))
+    # quantization error, not matmul error: bounded by the per-channel step
+    bound = (np.abs(x).sum(axis=1, keepdims=True) * (scale / 2)) + 1e-5
+    assert np.all(np.abs(out - x @ w) <= bound)
+    w_back = np.asarray(dequantize_leaf(q, scale))
+    assert w_back.dtype == np.float32
+    assert np.all(np.abs(w_back - w) <= scale / 2 + 1e-7)
+
+
+# --- engine contract ---------------------------------------------------------
+
+
+def test_engine_serve_contract_and_bytes(quant_stack):
+    _, engine_f, _, engine_q, _, _ = quant_stack
+    # identical AOT contract: same buckets, compile_count pinned at warmup
+    assert engine_q.buckets == engine_f.buckets
+    assert engine_q.compile_count == len(engine_q.buckets)
+    assert engine_q.ready
+    # weights stay int8 on device, and the footprint drops accordingly
+    assert engine_q.quantized and not engine_f.quantized
+    assert engine_q.weights_dtype == "int8"
+    assert engine_f.weights_dtype == "float32"
+    assert engine_q.param_bytes() <= 0.55 * engine_f.param_bytes(), (
+        engine_q.param_bytes(), engine_f.param_bytes())
+    int8_leaves = [v for v in jax.tree.leaves(engine_q.params)
+                   if np.dtype(v.dtype) == np.int8]
+    assert int8_leaves and len(int8_leaves) == len(engine_q.scales)
+
+
+def test_quant_predictions_deterministic_across_loads(quant_stack):
+    from vitax.serve import InferenceEngine
+    cfg, _, cfg_q, engine_q, _, int8_path = quant_stack
+    images, _ = gate_batch(cfg, n=4)
+    ids_a, probs_a = engine_q.predict(images)
+    engine_q2 = InferenceEngine.from_npz(cfg_q, int8_path)
+    engine_q2.warmup()
+    ids_b, probs_b = engine_q2.predict(images)
+    # bitwise: same int8 leaves + same AOT program => identical bits
+    np.testing.assert_array_equal(ids_a, ids_b)
+    np.testing.assert_array_equal(probs_a, probs_b)
+
+
+def test_quant_predictions_identical_across_buckets(quant_stack):
+    cfg, _, _, engine_q, _, _ = quant_stack
+    img = np.full((1, cfg.image_size, cfg.image_size, 3), 9, np.uint8)
+    one = engine_q.predict(img)                      # bucket 1
+    four = engine_q.predict(np.repeat(img, 4, axis=0))  # bucket 4
+    np.testing.assert_array_equal(one[0][0], four[0][3])
+    np.testing.assert_allclose(one[1][0], four[1][3], rtol=1e-5)
+
+
+def test_quant_zero_recompiles_under_mixed_traffic(quant_stack):
+    cfg, _, _, engine_q, _, _ = quant_stack
+    before = engine_q.compile_count
+    for n in (3, 1, 4, 2, 1, 3):
+        engine_q.predict(
+            np.zeros((n, cfg.image_size, cfg.image_size, 3), np.uint8))
+    assert engine_q.compile_count == before == len(engine_q.buckets)
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        engine_q.predict(
+            np.zeros((5, cfg.image_size, cfg.image_size, 3), np.uint8))
+
+
+def test_from_npz_rejects_unquantized_file_when_quant_expected(quant_stack):
+    from vitax.serve import InferenceEngine
+    _, _, cfg_q, _, f32_path, _ = quant_stack
+    with pytest.raises(ValueError, match="no __quant__ manifest"):
+        InferenceEngine.from_npz(cfg_q, f32_path)
+
+
+# --- accuracy gate -----------------------------------------------------------
+
+
+def test_quant_gate_within_threshold_and_reported(quant_stack, tmp_path):
+    from vitax.serve.quant import run_quant_gate
+    from vitax.telemetry.record import build_recorder
+    cfg, engine_f, _, engine_q, _, _ = quant_stack
+    metrics_dir = str(tmp_path / "metrics")
+    rec_cfg = tiny_cfg(metrics_dir=metrics_dir)
+    recorder = build_recorder(rec_cfg, n_devices=8, device_kind="cpu")
+    assert recorder is not None
+    images, labels = gate_batch(cfg)
+    gate = run_quant_gate(engine_f, engine_q, images, labels,
+                          recorder=recorder)
+    recorder.close()
+    # the hard CI threshold: int8 top-1 within 1.0 points of f32
+    assert abs(gate["delta_top1"]) <= GATE_MAX_TOP1_DELTA_PTS, gate
+    assert gate["n"] == GATE_N
+    assert gate["weights_dtype"] == "int8"
+    assert gate["baseline_dtype"] == "float32"
+    # the event landed in the run log with the full payload
+    jsonl = os.path.join(metrics_dir, "metrics.jsonl")
+    events = [json.loads(line) for line in open(jsonl)]
+    gates = [e for e in events if e.get("kind") == "quant_gate"]
+    assert len(gates) == 1
+    for key in ("top1_f32", "top1_quant", "top5_f32", "top5_quant",
+                "delta_top1", "delta_top5", "n", "weights_dtype"):
+        assert key in gates[0], key
+    # and metrics_report --json surfaces it
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_report.py"),
+         jsonl, "--json"],
+        capture_output=True, text=True, timeout=60)
+    # exit 2 = "no step records", the contract for an event-only log
+    assert proc.returncode == 2, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout)
+    qg = summary["quant_gate_last"]
+    assert qg["weights_dtype"] == "int8"
+    assert qg["delta_top1"] == gate["delta_top1"]
+    assert qg["n"] == GATE_N
+    # human mode prints the gate line
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_report.py"),
+         jsonl], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "quant gate (int8 vs float32)" in proc.stdout
+
+
+# --- /metrics footprint keys -------------------------------------------------
+
+
+def test_server_metrics_report_weight_footprint(quant_stack):
+    """The single-engine /metrics surface: weights_dtype + param_bytes come
+    straight from the engine accounting (scraped by serve_bench)."""
+    from vitax.serve import start_server, stop_server
+    import urllib.request
+    _, _, cfg_q, engine_q, _, _ = quant_stack
+    httpd, ctx = start_server(cfg_q, engine_q, port=0)
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/metrics"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            snap = json.load(resp)
+        assert snap["weights_dtype"] == "int8"
+        assert snap["param_bytes"] == engine_q.param_bytes()
+        # serve_bench's scraper reads the same keys
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import serve_bench
+            weights = serve_bench.scrape_weights(
+                f"http://127.0.0.1:{httpd.server_address[1]}")
+        finally:
+            sys.path.pop(0)
+        assert weights == {"param_bytes": engine_q.param_bytes(),
+                           "weights_dtype": "int8"}
+    finally:
+        stop_server(httpd, ctx)

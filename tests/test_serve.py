@@ -183,11 +183,11 @@ def test_flatten_unflatten_round_trip():
         np.testing.assert_array_equal(a, b)
 
 
-@pytest.mark.parametrize("dtype", [None, "float32", "bfloat16"])
+@pytest.mark.parametrize("dtype", [None, "float32", "bfloat16", "int8"])
 def test_save_npz_dtype_round_trip(tmp_path, dtype):
     import ml_dtypes
     from vitax.checkpoint.consolidate import load_npz, save_npz
-    flat = {"a/w": np.arange(6, dtype=np.float32).reshape(2, 3),
+    flat = {"a/kernel": np.arange(6, dtype=np.float32).reshape(2, 3),
             "a/b": np.ones(3, np.float32),
             "step": np.asarray(7, np.int32)}
     out = str(tmp_path / f"x_{dtype}.npz")
@@ -195,12 +195,20 @@ def test_save_npz_dtype_round_trip(tmp_path, dtype):
     back = load_npz(out)
     assert set(back) == set(flat)
     if dtype == "bfloat16":
-        assert back["a/w"].dtype == ml_dtypes.bfloat16
+        assert back["a/kernel"].dtype == ml_dtypes.bfloat16
         np.testing.assert_allclose(
-            back["a/w"].astype(np.float32), flat["a/w"], rtol=1e-2)
+            back["a/kernel"].astype(np.float32), flat["a/kernel"], rtol=1e-2)
+    elif dtype == "int8":
+        # generic load dequantizes back to f32 within half a quant step
+        assert back["a/kernel"].dtype == np.float32
+        atol = float(np.abs(flat["a/kernel"]).max()) / 127.0
+        np.testing.assert_allclose(back["a/kernel"], flat["a/kernel"],
+                                   atol=atol)
+        # the bias is not a matmul weight: untouched
+        np.testing.assert_array_equal(back["a/b"], flat["a/b"])
     else:
-        assert back["a/w"].dtype == np.float32
-        np.testing.assert_array_equal(back["a/w"], flat["a/w"])
+        assert back["a/kernel"].dtype == np.float32
+        np.testing.assert_array_equal(back["a/kernel"], flat["a/kernel"])
     # non-float leaves are never cast
     assert back["step"].dtype == np.int32 and int(back["step"]) == 7
 

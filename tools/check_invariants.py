@@ -2,7 +2,8 @@
 """CI gate: verify compiled-program invariants across parallelism arms.
 
 CPU-AOT-lowers the train step for each parallelism arm (dp / zero2 / zero3 /
-zero3_overlap / accum / moe — plus a warmed-up serve engine), then runs every
+zero3_overlap / accum / moe — plus warmed-up serve engines, full-precision
+and int8-quantized), then runs every
 applicable rule from vitax.analysis.rules over the lowered StableHLO and the
 post-`spmd-partitioning` HLO. The partitioned module is the real program
 (GSPMD lineage): properties like "gathers are bf16", "state buffers are

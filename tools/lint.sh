@@ -23,7 +23,8 @@ for path in vitax/telemetry tools/metrics_report.py \
             tests/test_snapshot.py \
             vitax/analysis/concurrency.py vitax/telemetry/threads.py \
             tests/test_concurrency_lint.py \
-            vitax/serve/fleet/breaker.py tests/test_chaos.py; do
+            vitax/serve/fleet/breaker.py tests/test_chaos.py \
+            vitax/serve/quant.py tests/test_quant.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
@@ -43,10 +44,12 @@ if [ "${VITAX_LINT_SKIP_CONCURRENCY:-0}" != "1" ]; then
 fi
 
 # compiled-program invariants, fast arm subset (VTX-Rnnn; rules.FAST_ARMS —
-# one train arm exercising every train rule, plus the serve arm).
+# one train arm exercising every train rule, plus the full-precision and
+# quantized serve arms for R006/R007).
 # VITAX_LINT_SKIP_INVARIANTS=1 skips on boxes without the jax toolchain.
 if [ "${VITAX_LINT_SKIP_INVARIANTS:-0}" != "1" ]; then
-    python tools/check_invariants.py --arms zero3_overlap serve || exit 1
+    python tools/check_invariants.py \
+        --arms zero3_overlap serve serve_quant || exit 1
 fi
 
 if ! python -m flake8 --version >/dev/null 2>&1; then

@@ -164,6 +164,16 @@ def summarize(path: str) -> dict:
         last = max(evals, key=lambda e: (e.get("epoch", 0), e.get("time", 0)))
         summary["eval_last"] = {k: last.get(k)
                                 for k in ("epoch", "top1", "top5", "n")}
+    # quantized-serving accuracy gate (vitax/serve/quant.py run_quant_gate):
+    # latest quantized-vs-f32 comparison; deltas are in points
+    gates = [e for e in events if e.get("kind") == "quant_gate"]
+    if gates:
+        last = max(gates, key=lambda e: e.get("time", 0))
+        summary["quant_gate_last"] = {
+            k: last.get(k)
+            for k in ("weights_dtype", "baseline_dtype",
+                      "top1_f32", "top1_quant", "top5_f32", "top5_quant",
+                      "delta_top1", "delta_top5", "n")}
     if not steps:
         return summary
 
@@ -271,6 +281,13 @@ def print_human(summary: dict) -> None:
     if ev:
         print(f"  eval (epoch {ev['epoch']}): top1 {ev['top1']:.4f}  "
               f"top5 {ev['top5']:.4f}  (n={ev['n']})")
+    qg = summary.get("quant_gate_last")
+    if qg:
+        print(f"  quant gate ({qg['weights_dtype']} vs "
+              f"{qg['baseline_dtype']}): top1 {qg['top1_quant']:.4f} "
+              f"(delta {qg['delta_top1']:+.2f} pts)  "
+              f"top5 {qg['top5_quant']:.4f} "
+              f"(delta {qg['delta_top5']:+.2f} pts)  (n={qg['n']})")
     if not summary["records"]:
         print("  no step records — nothing to summarize")
         return

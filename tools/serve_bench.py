@@ -315,6 +315,27 @@ def summarize_serve_jsonl(path: str, since: float) -> dict:
     }
 
 
+def scrape_weights(url: str, timeout: float = 2.0):
+    """Weight-footprint keys from a server or router /metrics: the single
+    engine reports weights_dtype/param_bytes at top level, the fleet router
+    aggregates them under "fleet" (vitax/serve/quant.py export path). None
+    when the endpoint (or an older server) doesn't report them."""
+    try:
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=timeout) as resp:
+            snap = json.loads(resp.read())
+    except Exception:  # noqa: BLE001  scrape is best-effort
+        return None
+    for scope in (snap, snap.get("fleet") or {}):
+        if "param_bytes" in scope:
+            return {
+                "param_bytes": int(scope["param_bytes"]),
+                "weights_dtype": scope.get("weights_dtype",
+                                           scope.get("weights_dtypes")),
+            }
+    return None
+
+
 def run_bench(url: str, concurrency: int, requests_per_worker: int,
               image_size: int, timeout: float, serve_jsonl: str = "",
               target_rps: float = 0.0, slo_p99_ms: float = 0.0,
@@ -378,6 +399,9 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
         }
     if sampler is not None:
         summary["fleet"] = sampler.stop()
+    weights = scrape_weights(url, timeout=min(timeout, 5.0))
+    if weights is not None:
+        summary["weights"] = weights
     if chaos_installed is not None:
         summary["chaos"] = chaos_installed
     if serve_jsonl:
@@ -415,6 +439,10 @@ def print_human(s: dict) -> None:
                   f"{fleet['breaker_opens']} breaker opens, "
                   f"{fleet['retry_budget_exhausted']} budget-exhausted, "
                   f"degraded {fleet['degraded_seconds']:.1f}s")
+    weights = s.get("weights")
+    if weights:
+        print(f"  weights: {weights['weights_dtype']} "
+              f"({weights['param_bytes']:,} B device-resident)")
     srv = s.get("server")
     if srv and srv["records"]:
         print(f"  server ({srv['records']} records): "

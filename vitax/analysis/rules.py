@@ -310,12 +310,67 @@ def check_serve_no_recompile(program: Program, cfg: Config) -> List[Finding]:
     return out
 
 
+@rule("VTX-R007", "quant-weights-resident-int8", "ERROR", ("serve",),
+      "a quantized serve program must hold its matmul weights AS INT8: every "
+      "manifested leaf int8 on device, the lowered program taking exactly "
+      "one i8 argument per scaled leaf, and no floating weight argument at "
+      "or above block-matrix size (a dequant hoisted out of jit materializes "
+      "the f32 copy the int8 export exists to avoid — 4x the HBM, silently)",
+      applies_to=lambda cfg: bool(getattr(cfg, "serve_quant_dtype", "")))
+def check_quant_weights_resident(program: Program, cfg: Config) -> List[Finding]:
+    r = QUANT_WEIGHTS_RESIDENT
+    import numpy as np
+    eng = program.engine
+    out: List[Finding] = []
+    scales = getattr(eng, "scales", {})
+    if not scales:
+        return [_finding(
+            r, program,
+            f"--serve_quant_dtype {cfg.serve_quant_dtype} but the engine "
+            f"carries no quant scales — serving full-precision weights")]
+    # (1) device residency: every scaled leaf must actually be int8 — an f32
+    # leaf paired with a scale is a dequant that happened at load time
+    from vitax.checkpoint.consolidate import flatten_tree
+    for key, leaf in flatten_tree(eng.params).items():
+        if key in scales and np.dtype(leaf.dtype) != np.int8:
+            out.append(_finding(
+                r, program,
+                f"scaled leaf {key} is resident as {leaf.dtype}, not int8 — "
+                f"dequantized outside the jitted program",
+                key=key, dtype=str(leaf.dtype)))
+    # (2) the lowered program's weight operands: one i8 argument per scaled
+    # leaf, and no block-sized floating argument (pos_embed and LN leaves sit
+    # far below the threshold at every geometry)
+    mlir = eng.lower_bucket_mlir(eng.buckets[-1])
+    args = hlo.mlir_main_args(mlir)
+    n_i8 = sum(1 for a in args if a["dtype"] == "i8")
+    if n_i8 != len(scales):
+        out.append(_finding(
+            r, program,
+            f"lowered program has {n_i8} i8 arguments for {len(scales)} "
+            f"scaled leaves — quantized weights are not entering the "
+            f"program as int8",
+            i8_args=n_i8, scaled_leaves=len(scales)))
+    threshold = large_param_threshold_bytes(cfg)
+    for a in args:
+        if a["dtype"] in ("f32", "f64", "bf16", "f16") and a["bytes"] >= threshold:
+            out.append(_finding(
+                r, program,
+                f"block-sized floating argument arg{a['index']} "
+                f"({a['dtype']}{a['shape']}, {a['bytes']:,} B) in the "
+                f"quantized serve program — a materialized dequantized "
+                f"weight",
+                arg=a, threshold_bytes=threshold))
+    return out
+
+
 NO_HOST_TRANSFER = RULES[0]
 DONATION_HONORED = RULES[1]
 COLLECTIVE_DTYPE = RULES[2]
 GATHER_OVERLAP = RULES[3]
 NO_REPLICATED_LARGE = RULES[4]
 SERVE_NO_RECOMPILE = RULES[5]
+QUANT_WEIGHTS_RESIDENT = RULES[6]
 
 
 def rules_for(program: Program) -> List[Rule]:
@@ -358,16 +413,22 @@ TRAIN_ARMS: Dict[str, dict] = {
 }
 
 SERVE_ARM = "serve"
-ALL_ARMS = tuple(TRAIN_ARMS) + (SERVE_ARM,)
+# quantized serving: same geometry with the params int8-quantized in memory
+# (vitax/serve/quant.py quantize_params_for_serve); runs R006 (the AOT
+# contract is dtype-blind) plus R007
+SERVE_QUANT_ARM = "serve_quant"
+ALL_ARMS = tuple(TRAIN_ARMS) + (SERVE_ARM, SERVE_QUANT_ARM)
 # the lint.sh / pre-push subset: one train arm covering R001-R005 (the
-# overlap arm applies every train rule) plus the serve arm for R006
-FAST_ARMS = ("zero3_overlap", SERVE_ARM)
+# overlap arm applies every train rule) plus both serve arms for R006/R007
+FAST_ARMS = ("zero3_overlap", SERVE_ARM, SERVE_QUANT_ARM)
 
 
 def arm_config(arm: str, **overrides) -> Config:
     kw = dict(BASE_GEOMETRY)
     if arm == SERVE_ARM:
         kw.update(serve_max_batch=4)
+    elif arm == SERVE_QUANT_ARM:
+        kw.update(serve_max_batch=4, serve_quant_dtype="int8")
     else:
         kw.update(TRAIN_ARMS[arm])
     kw.update(overrides)
@@ -407,7 +468,16 @@ def build_serve_program(cfg: Config, arm: str = SERVE_ARM) -> Program:
     params, _ = init_sharded_params(
         lambda rng: model.init(rng, sample, True),
         jax.random.key(cfg.seed), cfg, mesh)
-    engine = InferenceEngine(cfg, mesh, model, params)
+    scales, quant_dtype = None, ""
+    if getattr(cfg, "serve_quant_dtype", ""):
+        # in-memory quantization — the arm exercises the int8 serve program
+        # without a checkpoint on disk (random weights: the residency and
+        # AOT invariants do not depend on the values)
+        from vitax.serve.quant import quantize_params_for_serve
+        params, scales = quantize_params_for_serve(params, cfg, mesh)
+        quant_dtype = cfg.serve_quant_dtype
+    engine = InferenceEngine(cfg, mesh, model, params,
+                             scales=scales, quant_dtype=quant_dtype)
     engine.warmup()
     return Program(kind="serve", arm=arm, config=cfg,
                    mesh_shape=dict(mesh.shape), engine=engine)
@@ -415,6 +485,6 @@ def build_serve_program(cfg: Config, arm: str = SERVE_ARM) -> Program:
 
 def build_program(arm: str, **overrides) -> Program:
     cfg = arm_config(arm, **overrides)
-    if arm == SERVE_ARM:
-        return build_serve_program(cfg)
+    if arm in (SERVE_ARM, SERVE_QUANT_ARM):
+        return build_serve_program(cfg, arm=arm)
     return build_train_program(cfg, arm=arm)

@@ -16,12 +16,14 @@ Usage:
     python -m vitax.checkpoint.consolidate --ckpt_dir /path --epoch 10 --out full.npz
     python -m vitax.checkpoint.consolidate ... --params_only
     python -m vitax.checkpoint.consolidate ... --dtype bfloat16   # half-size export
+    python -m vitax.checkpoint.consolidate ... --dtype int8       # quantized export
 """
 
 from __future__ import annotations
 
 import argparse
-from typing import Dict, Optional
+import json
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -31,6 +33,114 @@ from vitax.checkpoint.orbax_io import epoch_ckpt_path
 # their keys recorded under this manifest entry, so load_npz can restore the
 # exact dtype. The key cannot collide with a param path ("/"-joined names).
 BF16_MANIFEST_KEY = "__bfloat16_keys__"
+
+# --dtype int8 manifest: a JSON document under this key records which leaves
+# were quantized, keyed BY QUANTIZED DTYPE so fp8 on supporting TPUs is a new
+# entry in the same schema, not a rework:
+#     {"schema": 1, "dtypes": {"int8": ["params/head/kernel", ...]}}
+# ("float8_e4m3" is the reserved next slot.) Each quantized leaf's per-output-
+# channel float32 scales live beside it at QUANT_SCALE_PREFIX + key. Neither
+# key can collide with a param path ("/"-joined names never start with "__").
+QUANT_MANIFEST_KEY = "__quant__"
+QUANT_SCALE_PREFIX = "__scale__/"
+QUANT_SCHEMA_VERSION = 1
+QUANT_DTYPES = ("int8",)            # implemented; "float8_e4m3" reserved
+
+# Leaves never quantized, by path name: the MoE router and every LayerNorm —
+# the same names vitax/parallel/sharding.py KEEP_F32_PARAMS keeps out of the
+# bf16 comm cast, MINUS "head": the head kernel is a full (d, num_classes)
+# matmul weight and dequantizes back to f32 at use, so int8 storage does not
+# change where its compute happens (tests/test_quant.py pins the relation to
+# KEEP_F32_PARAMS).
+QUANT_SKIP_NAMES = ("router", "norm", "norm1", "norm2")
+
+# matmul weight leaf names: Dense/Conv kernels plus the MoE expert matrices
+# (vitax/models/moe.py w1/w2). Biases, LN params, pos_embed and every other
+# 1-D/scalar leaf stay f32.
+QUANT_WEIGHT_NAMES = ("kernel", "w1", "w2")
+
+
+def _is_float(v: np.ndarray) -> bool:
+    """Floating leaves only — integer/bool leaves (step counters, already-
+    quantized int8 weights) must never be touched by a --dtype cast."""
+    import ml_dtypes
+    return bool(np.issubdtype(v.dtype, np.floating)
+                or v.dtype == ml_dtypes.bfloat16)
+
+
+def should_quantize(key: str, v: np.ndarray) -> bool:
+    """Whether --dtype int8 quantizes this leaf: a 2-D+ floating matmul
+    weight (patchify/QKV/proj/MLP/head) not under a skip name."""
+    parts = key.split("/")
+    return (_is_float(v) and v.ndim >= 2
+            and parts[-1] in QUANT_WEIGHT_NAMES
+            and not any(p in QUANT_SKIP_NAMES for p in parts))
+
+
+def _contraction_axes(key: str, ndim: int) -> Tuple[int, ...]:
+    """Axes reduced by the absmax scale: everything except the output-channel
+    (last) axis and any leading stacking axes — the scan-stacked layer dim of
+    block params ("blocks" in the path) and the experts dim of MoE w1/w2 —
+    so scales stay per (layer[, expert], out_channel)."""
+    parts = key.split("/")
+    stack = 1 if "blocks" in parts else 0
+    if parts[-1] in ("w1", "w2"):
+        stack += 1  # (…, E, in, out): experts are independent matmuls
+    return tuple(range(stack, ndim - 1))
+
+
+def quantize_leaf(key: str, v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric absmax int8 quantization.
+
+    scale = absmax / 127 over the contraction axes (keepdims, so dequant is
+    the broadcast `w_int8 * scale`); w_int8 = round(w / scale) in [-127, 127].
+    All-zero channels get scale 1.0 (they quantize and dequantize to 0)."""
+    w = np.asarray(v, dtype=np.float32)
+    axes = _contraction_axes(key, w.ndim)
+    absmax = np.max(np.abs(w), axis=axes, keepdims=True) if axes else np.abs(w)
+    scale = (absmax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, np.float32(1.0), scale)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def quantize_flat(flat: Dict[str, np.ndarray]) -> Tuple[
+        Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """Quantize every eligible leaf of a flat tree.
+
+    Returns (flat with int8 leaves substituted, {key: float32 scales}).
+    Ineligible leaves pass through untouched."""
+    out, scales = {}, {}
+    for k, v in flat.items():
+        if should_quantize(k, v):
+            out[k], scales[k] = quantize_leaf(k, v)
+        else:
+            out[k] = v
+    return out, scales
+
+
+def quant_manifest(scales_keys) -> str:
+    """The dtype-keyed JSON manifest body for a set of int8-quantized keys."""
+    return json.dumps({"schema": QUANT_SCHEMA_VERSION,
+                       "dtypes": {"int8": sorted(scales_keys)}})
+
+
+def parse_quant_manifest(doc: str) -> Dict[str, str]:
+    """{key: quantized dtype} from a manifest JSON document (dtype-keyed on
+    disk; inverted here because consumers look leaves up by key)."""
+    parsed = json.loads(doc)
+    assert parsed.get("schema") == QUANT_SCHEMA_VERSION, (
+        f"unknown quant manifest schema {parsed.get('schema')!r} "
+        f"(this build reads schema {QUANT_SCHEMA_VERSION})")
+    out: Dict[str, str] = {}
+    for dtype, keys in parsed.get("dtypes", {}).items():
+        assert dtype in QUANT_DTYPES, (
+            f"quantized dtype {dtype!r} not supported by this build "
+            f"(implemented: {QUANT_DTYPES}; float8_e4m3 is the reserved "
+            f"next slot)")
+        for k in keys:
+            out[k] = dtype
+    return out
 
 
 def flatten_tree(tree, sep: str = "/") -> Dict[str, np.ndarray]:
@@ -63,17 +173,27 @@ def unflatten_tree(flat: Dict[str, np.ndarray], sep: str = "/") -> dict:
 
 def save_npz(out: str, flat: Dict[str, np.ndarray],
              dtype: Optional[str] = None) -> Dict[str, np.ndarray]:
-    """Write a flat tree as .npz, optionally casting every float array.
+    """Write a flat tree as .npz, optionally casting/quantizing float arrays.
 
     dtype "bfloat16" halves the export; bf16 has no npz dtype, so those
     arrays are stored as uint16 bit-views plus a key manifest
-    (BF16_MANIFEST_KEY) that load_npz uses to restore them exactly."""
+    (BF16_MANIFEST_KEY) that load_npz uses to restore them exactly.
+
+    dtype "int8" quantizes every eligible matmul weight (should_quantize)
+    per output channel and records the key set under QUANT_MANIFEST_KEY with
+    the float32 scales at QUANT_SCALE_PREFIX + key; ineligible float leaves
+    stay at their stored dtype, so an int8 export of a bf16 tree carries both
+    manifests in one file. Casts touch FLOATING leaves only — integer/bool
+    leaves (step counters, pre-quantized int8 weights) round-trip exactly
+    under every --dtype."""
     import ml_dtypes
-    if dtype:
+    scales: Dict[str, np.ndarray] = {}
+    if dtype == "int8":
+        flat, scales = quantize_flat(flat)
+    elif dtype:
         target = (ml_dtypes.bfloat16 if dtype == "bfloat16"
                   else np.dtype(dtype))
-        flat = {k: v.astype(target) if np.issubdtype(v.dtype, np.floating)
-                or v.dtype == ml_dtypes.bfloat16 else v
+        flat = {k: v.astype(target) if _is_float(v) else v
                 for k, v in flat.items()}
     bf16_keys = sorted(k for k, v in flat.items()
                        if v.dtype == ml_dtypes.bfloat16)
@@ -81,19 +201,55 @@ def save_npz(out: str, flat: Dict[str, np.ndarray],
                for k, v in flat.items()}
     if bf16_keys:
         payload[BF16_MANIFEST_KEY] = np.asarray(bf16_keys)
+    if scales:
+        payload[QUANT_MANIFEST_KEY] = np.asarray(quant_manifest(scales))
+        for k, s in scales.items():
+            payload[QUANT_SCALE_PREFIX + k] = s
     np.savez(out, **payload)
     return flat
 
 
-def load_npz(path: str) -> Dict[str, np.ndarray]:
-    """Read a save_npz export back to {key: array}, restoring bf16 views."""
+def load_npz_raw(path: str) -> Tuple[Dict[str, np.ndarray],
+                                     Dict[str, np.ndarray],
+                                     Dict[str, str]]:
+    """Read a save_npz export without dequantizing.
+
+    Returns (flat, scales, manifest): `flat` holds quantized leaves at their
+    stored int8 dtype (bf16 views restored as usual), `scales` the per-key
+    float32 scale arrays, `manifest` {key: quantized dtype} — all empty dicts
+    but `flat` for an unquantized file. This is the serving load path:
+    InferenceEngine.from_npz device_puts the int8 leaves as int8."""
     import ml_dtypes
     with np.load(path) as data:
         bf16 = (set(str(k) for k in data[BF16_MANIFEST_KEY])
                 if BF16_MANIFEST_KEY in data.files else set())
-        return {k: (data[k].view(ml_dtypes.bfloat16) if k in bf16
-                    else data[k])
-                for k in data.files if k != BF16_MANIFEST_KEY}
+        manifest = (parse_quant_manifest(str(data[QUANT_MANIFEST_KEY]))
+                    if QUANT_MANIFEST_KEY in data.files else {})
+        flat, scales = {}, {}
+        for k in data.files:
+            if k in (BF16_MANIFEST_KEY, QUANT_MANIFEST_KEY):
+                continue
+            if k.startswith(QUANT_SCALE_PREFIX):
+                scales[k[len(QUANT_SCALE_PREFIX):]] = data[k]
+            else:
+                flat[k] = (data[k].view(ml_dtypes.bfloat16) if k in bf16
+                           else data[k])
+        assert set(manifest) == set(scales), (
+            f"quant manifest/scale mismatch in {path}: manifest names "
+            f"{sorted(set(manifest) ^ set(scales))} without scales (or "
+            f"vice versa)")
+        return flat, scales, manifest
+
+
+def load_npz(path: str) -> Dict[str, np.ndarray]:
+    """Read a save_npz export back to {key: array}, restoring bf16 views and
+    dequantizing int8 leaves to float32 (key set == the saved tree's; generic
+    consumers never see scales). Serving wants the int8 leaves verbatim —
+    use load_npz_raw there."""
+    flat, scales, manifest = load_npz_raw(path)
+    for k in manifest:
+        flat[k] = (flat[k].astype(np.float32) * scales[k]).astype(np.float32)
+    return flat
 
 
 def consolidate(ckpt_dir: str, epoch: int, out: str, params_only: bool = True,
@@ -131,11 +287,16 @@ def main(argv=None):
     p.add_argument("--full_state", action="store_false", dest="params_only",
                    help="include optimizer state and step, not just params")
     p.add_argument("--dtype", type=str, default=None,
-                   choices=["float32", "bfloat16"],
+                   choices=["float32", "bfloat16", "int8"],
                    help="cast float arrays for the export (default: keep "
                         "the stored dtype). bfloat16 halves the file — the "
                         "serving engine computes in bf16 anyway "
-                        "(vitax/serve/engine.py from_npz)")
+                        "(vitax/serve/engine.py from_npz). int8 quantizes "
+                        "every matmul weight per output channel (symmetric "
+                        "absmax, float32 scales under the __quant__ "
+                        "manifest) for ~4x smaller serve weights; LN/bias/"
+                        "router leaves stay f32 (see README 'Quantized "
+                        "serving')")
     args = p.parse_args(argv)
     consolidate(args.ckpt_dir, args.epoch, args.out, args.params_only,
                 dtype=args.dtype)

@@ -199,6 +199,12 @@ class Config:
     max_batch_wait_ms: float = 5.0      # dynamic batcher flush deadline: a queued request waits at
     #   most this long for the bucket to fill (vitax/serve/batcher.py)
     serve_topk: int = 5                 # classes returned per /predict response
+    serve_quant_dtype: str = ""         # expected weight quantization of the serve export: "" (full
+    #   precision) or "int8" (per-channel weights from consolidate.py
+    #   --dtype int8, dequantized at use inside the jitted forward —
+    #   vitax/serve/quant.py). The npz manifest is authoritative; this flag
+    #   asserts it, and gates the VTX-R007 invariant arm. "float8_e4m3" is
+    #   reserved in the manifest schema but not yet a valid value here
     serve_queue_max: int = 1024         # dynamic batcher queue bound: submit() on a full queue raises
     #   QueueFull, which the single-engine server answers 503 (reason
     #   "queue_full") and the fleet router maps to an admission shed (429)
@@ -440,6 +446,11 @@ class Config:
         assert self.max_batch_wait_ms >= 0, (
             f"--max_batch_wait_ms must be >= 0 (0 = flush every request "
             f"immediately), got {self.max_batch_wait_ms}")
+        assert self.serve_quant_dtype in ("", "int8"), (
+            f"--serve_quant_dtype must be '' or 'int8', got "
+            f"{self.serve_quant_dtype!r}; float8_e4m3 is reserved in the "
+            f"__quant__ manifest schema (vitax/checkpoint/consolidate.py) "
+            f"but has no serve path yet")
         assert self.serve_topk >= 1, (
             f"--serve_topk must be >= 1, got {self.serve_topk}; values above "
             f"num_classes are clamped by the engine at load time "
@@ -698,6 +709,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "bucket to fill before the batch is flushed")
     serve.add_argument("--serve_topk", type=int, default=5,
                        help="classes returned per /predict response")
+    serve.add_argument("--serve_quant_dtype", type=str, default="",
+                       choices=["", "int8"],
+                       help="expected weight quantization of the serve "
+                            "export ('' = full precision); asserts the npz "
+                            "__quant__ manifest matches at load")
     serve.add_argument("--serve_queue_max", type=int, default=1024,
                        help="dynamic batcher queue bound: a submit against "
                             "a full queue raises QueueFull, answered 503 "

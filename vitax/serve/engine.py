@@ -81,14 +81,25 @@ class InferenceEngine:
     accepts traffic.
     """
 
-    def __init__(self, cfg: Config, mesh: Mesh, model, params):
+    def __init__(self, cfg: Config, mesh: Mesh, model, params,
+                 scales: Optional[Dict[str, jax.Array]] = None,
+                 quant_dtype: str = ""):
         assert getattr(cfg, "pp_size", 1) == 1, (
             "serving v1 runs the non-pipelined forward; restore a pp "
             "checkpoint with --pp_size 1 (Orbax reshards on load)")
+        assert bool(scales) == bool(quant_dtype), (
+            "quantized engines carry both scales and quant_dtype")
         self.cfg = cfg
         self.mesh = mesh
         self.model = model
         self.params = params
+        # quantized serving: int8 leaves stay int8 on device; scales is the
+        # flat {param_key: float32 per-output-channel scale} side table
+        # (replicated — O(out_channels)), and the jitted predict dequantizes
+        # at use so XLA fuses the convert into the matmul (vitax/serve/
+        # quant.py). Empty scales = plain full-precision engine.
+        self.scales: Dict[str, jax.Array] = scales or {}
+        self.quant_dtype = quant_dtype
         self.topk = min(cfg.serve_topk, cfg.num_classes)
         self.buckets = bucket_sizes(cfg.serve_max_batch)
         self.compile_count = 0          # warmup compiles; pinned by tests
@@ -104,6 +115,30 @@ class InferenceEngine:
         self._batch_devices = 1
         for ax in BATCH_AXES:
             self._batch_devices *= mesh.shape.get(ax, 1)
+
+    # --- accounting (reported on /metrics and by serve_bench) -------------
+
+    @property
+    def quantized(self) -> bool:
+        return bool(self.scales)
+
+    @property
+    def weights_dtype(self) -> str:
+        """Dtype of the matmul weights as resident on device: the quant
+        dtype for a quantized engine, else the dtype of the largest leaf
+        (LN/bias stragglers don't get to name a bf16 or f32 tree)."""
+        if self.scales:
+            return self.quant_dtype
+        largest = max(jax.tree.leaves(self.params), key=lambda v: v.size)
+        return str(largest.dtype)
+
+    def param_bytes(self) -> int:
+        """Device-resident parameter footprint: weight leaves plus the
+        quant scale side table, logical (unsharded) bytes — the per-replica
+        HBM number the fleet density math runs on."""
+        total = sum(int(v.nbytes) for v in jax.tree.leaves(self.params))
+        total += sum(int(v.nbytes) for v in self.scales.values())
+        return total
 
     # --- constructors -----------------------------------------------------
 
@@ -138,16 +173,41 @@ class InferenceEngine:
         """Restore params from a consolidated .npz export
         (vitax/checkpoint/consolidate.py) — the exact tree comes back through
         the shared flatten/unflatten key convention, then every leaf is
-        device_put into its param_specs shard layout."""
-        from vitax.checkpoint.consolidate import load_npz, unflatten_tree
+        device_put into its param_specs shard layout.
+
+        A `__quant__`-manifested export loads its int8 leaves AS INT8 on
+        device (param_pspec keys off path+shape, so the shard layout is the
+        f32 one) with the scale side table replicated; the file's manifest
+        is authoritative. --serve_quant_dtype only ASSERTS the expectation —
+        pointing a quantized config at an unquantized export fails loudly
+        instead of silently serving 4x the HBM."""
+        from vitax.checkpoint.consolidate import load_npz_raw, unflatten_tree
         from vitax.parallel.sharding import param_specs, shardings_of
         mesh = build_mesh(cfg)
         model = _build_model(cfg, mesh)
-        params = unflatten_tree(load_npz(path))
+        flat, scales, manifest = load_npz_raw(path)
+        want = getattr(cfg, "serve_quant_dtype", "")
+        if want and not manifest:
+            raise ValueError(
+                f"--serve_quant_dtype {want} but {path} has no __quant__ "
+                f"manifest; re-export with consolidate.py --dtype {want}")
+        params = unflatten_tree(flat)
         shardings = shardings_of(mesh, param_specs(params, cfg, mesh))
         params = jax.tree.map(jax.device_put, params, shardings)
-        master_print(f"serve: params from consolidated export {path}")
-        return cls(cfg, mesh, model, params)
+        quant_dtype = ""
+        if manifest:
+            from vitax.serve.quant import scale_shardings
+            quant_dtype = sorted(set(manifest.values()))[0]
+            sc_sh = scale_shardings(scales, mesh)
+            scales = {k: jax.device_put(v, sc_sh[k])
+                      for k, v in scales.items()}
+        else:
+            scales = {}
+        master_print(f"serve: params from consolidated export {path}"
+                     + (f" (quantized: {quant_dtype}, "
+                        f"{len(scales)} scaled leaves)" if manifest else ""))
+        return cls(cfg, mesh, model, params, scales=scales,
+                   quant_dtype=quant_dtype)
 
     # --- compilation ------------------------------------------------------
 
@@ -159,26 +219,60 @@ class InferenceEngine:
     def _predict_fn(self):
         model, k = self.model, self.topk
 
-        def predict(params, images):
+        def forward(params, images):
             from vitax.train.step import prepare_images
             logits = model.apply(params, prepare_images(images), True)
             probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
             top_p, top_i = jax.lax.top_k(probs, k)
             return top_i.astype(jnp.int32), top_p
 
-        return predict
+        if not self.scales:
+            return forward
 
-    def _compile_bucket(self, bucket: int) -> jax.stages.Compiled:
+        def predict_quant(params, scales, images):
+            # dequant INSIDE the jitted program: int8 weights enter as
+            # program arguments, `(w * scale).astype(f32)` fuses into each
+            # consuming matmul, and no f32 weight tensor outlives the call
+            # (VTX-R007 pins this on the lowered args)
+            from vitax.serve.quant import dequantize_tree
+            return forward(dequantize_tree(params, scales), images)
+
+        return predict_quant
+
+    def _lower_bucket(self, bucket: int):
+        """Lower (but do not compile) the predict program for one bucket —
+        shared by warmup compilation and the analysis rules, which inspect
+        the StableHLO without disturbing compile_count."""
         from vitax.parallel.sharding import param_specs, shardings_of
         batch_sh = self._batch_sharding(bucket)
         param_sh = shardings_of(
             self.mesh, param_specs(self.params, self.cfg, self.mesh))
-        fn = jax.jit(self._predict_fn(),
-                     in_shardings=(param_sh, batch_sh), out_shardings=None)
         s = self.cfg.image_size
         images = jax.ShapeDtypeStruct((bucket, s, s, 3), jnp.uint8,
                                       sharding=batch_sh)
-        compiled = fn.lower(self.params, images).compile()
+        if self.scales:
+            scale_sh = {k: NamedSharding(self.mesh, P())
+                        for k in self.scales}
+            fn = jax.jit(self._predict_fn(),
+                         in_shardings=(param_sh, scale_sh, batch_sh),
+                         out_shardings=None)
+            lowered = fn.lower(self.params, self.scales, images)
+        else:
+            fn = jax.jit(self._predict_fn(),
+                         in_shardings=(param_sh, batch_sh),
+                         out_shardings=None)
+            lowered = fn.lower(self.params, images)
+        return lowered, batch_sh
+
+    def lower_bucket_mlir(self, bucket: int) -> str:
+        """StableHLO text of one bucket's predict program (no compile, no
+        compile_count movement) — the VTX-R007 artifact."""
+        lowered, _ = self._lower_bucket(bucket)
+        return lowered.as_text()
+
+    def _compile_bucket(self, bucket: int) -> jax.stages.Compiled:
+        lowered, batch_sh = self._lower_bucket(bucket)
+        compiled = lowered.compile()
         self.compile_count += 1
         self._batch_shardings[bucket] = batch_sh
         return compiled
@@ -204,6 +298,8 @@ class InferenceEngine:
 
     def _run(self, bucket: int, images: np.ndarray):
         batch = jax.device_put(images, self._batch_shardings[bucket])
+        if self.scales:
+            return self._compiled[bucket](self.params, self.scales, batch)
         return self._compiled[bucket](self.params, batch)
 
     def predict(self, images: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

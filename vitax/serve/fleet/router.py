@@ -430,6 +430,18 @@ class Router:
             "degraded": self.manager.degraded_count(),
             "degraded_seconds": self.manager.degraded_seconds(),
         }
+        # weight-footprint aggregation (vitax/serve/quant.py): summed
+        # device-resident param bytes across scraped replicas and the set of
+        # weight dtypes in play (mixed during a quantized rollout). Only
+        # present when at least one replica reported them — older replicas
+        # without the keys degrade the scrape, not the schema.
+        reporting = [r["server"] for r in replicas.values()
+                     if "server" in r and "param_bytes" in r["server"]]
+        if reporting:
+            snap["fleet"]["param_bytes"] = sum(
+                int(s["param_bytes"]) for s in reporting)
+            snap["fleet"]["weights_dtypes"] = sorted(
+                {str(s.get("weights_dtype", "")) for s in reporting})
         snap["replicas"] = replicas
         with self._breaker_lock:
             breakers = list(self._breakers.items())

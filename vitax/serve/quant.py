@@ -1,0 +1,164 @@
+"""Quantized serving: int8 weights on device, dequantized at use.
+
+The serve half of the --dtype int8 export (vitax/checkpoint/consolidate.py):
+`InferenceEngine.from_npz` keeps every quantized leaf RESIDENT AS INT8 on
+device — the manifest's float32 per-output-channel scales are the only
+extra state — and the eval forward dequantizes at use, inside the jitted
+program: `(w_int8 * scale).astype(compute)` feeds the consuming matmul
+directly, so XLA fuses the convert+multiply into the dot's operand read and
+no f32 copy of a weight ever persists between calls. HBM per replica drops
+~4x on the weight tree (the fleet-density axis — README "Quantized
+serving"), while the AOT bucket contract, zero-recompile pin, and
+mesh/sharding layout are untouched: int8 leaves have the same shapes as
+their f32 originals, so `param_specs` shards them identically, and the
+scales (keepdims-broadcast, O(out_channels)) ride along replicated.
+
+The schema is dtype-keyed (int8 now, float8_e4m3 reserved —
+consolidate.QUANT_DTYPES), so fp8 on supporting TPUs is a new manifest
+entry and a new dequant kernel, not a rework. VTX-R007
+(vitax/analysis/rules.py) pins the result on the lowered program: large
+matmul operands int8-sourced, no block-sized float weight argument.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from vitax.checkpoint.consolidate import (
+    QUANT_SCALE_PREFIX,
+    flatten_tree,
+    quantize_flat,
+)
+
+PyTree = Any
+
+
+def dequant_spec(flat: Dict[str, np.ndarray],
+                 manifest: Dict[str, str]) -> Dict[str, dict]:
+    """Per-key load spec of a quantized export: the dtype-aware load tree
+    (the SNIPPETS §3 `make_shard_and_gather_fns(dtype_specs=...)` shape).
+
+    {key: {"dtype": stored dtype string, "quantized": bool, "scale_key":
+    scale entry name or None}} — from_npz walks this to decide which leaves
+    stay int8 on device and which device_put at their stored float dtype."""
+    spec: Dict[str, dict] = {}
+    for k, v in flat.items():
+        q = manifest.get(k)
+        spec[k] = {
+            "dtype": q if q else str(np.asarray(v).dtype),
+            "quantized": q is not None,
+            "scale_key": (QUANT_SCALE_PREFIX + k) if q else None,
+        }
+    return spec
+
+
+def dequantize_leaf(w_q: jax.Array, scale: jax.Array,
+                    dtype=jnp.float32) -> jax.Array:
+    """`(w_int8 * scale).astype(dtype)` — called INSIDE the jitted forward,
+    so the convert+multiply fuses into the consuming matmul's operand read
+    instead of materializing a resident full-precision copy."""
+    return (w_q.astype(dtype) * scale.astype(dtype)).astype(dtype)
+
+
+def fused_dequant_matmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+                         dtype=jnp.float32) -> jax.Array:
+    """x @ dequant(w_q): the canonical fused form — under jit XLA folds the
+    dequant into the dot's rhs, which is exactly what the engine's in-jit
+    `dequantize_tree` + flax Dense lowers to (tests/test_quant.py pins the
+    numerics against the f32 matmul)."""
+    return jnp.matmul(x.astype(dtype), dequantize_leaf(w_q, scale, dtype))
+
+
+def dequantize_tree(qparams: PyTree, scales: Dict[str, jax.Array],
+                    dtype=jnp.float32) -> PyTree:
+    """Rebuild the full-precision param tree from int8 leaves + flat scales
+    ("/"-joined keys, the flatten_tree convention). Must be called inside
+    the jitted predict: outside it, the result would be the resident f32
+    copy the whole design exists to avoid."""
+    def leaf(path, v):
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+            for p in path)
+        s = scales.get(key)
+        return v if s is None else dequantize_leaf(v, s, dtype)
+    return jax.tree_util.tree_map_with_path(leaf, qparams)
+
+
+def scale_shardings(scales: Dict[str, np.ndarray], mesh) -> Dict[str, NamedSharding]:
+    """Scales are O(out_channels) — replicate them; the int8 weights keep
+    the full param_specs layout (same shapes as their f32 originals)."""
+    return {k: NamedSharding(mesh, P()) for k in scales}
+
+
+def quantize_params_for_serve(params: PyTree, cfg, mesh) -> Tuple[PyTree, Dict[str, jax.Array]]:
+    """In-memory quantization of a (possibly sharded) param tree for a serve
+    engine: host-side per-channel int8 + scales, device_put back with the
+    weights in their original shard layout and the scales replicated. The
+    invariant arms use this to build the quantized serve program without a
+    checkpoint on disk (vitax/analysis/rules.py build_serve_program)."""
+    from vitax.checkpoint.consolidate import unflatten_tree
+    from vitax.parallel.sharding import param_specs, shardings_of
+    flat = {k: np.asarray(jax.device_get(v))
+            for k, v in flatten_tree(params).items()}
+    qflat, scales = quantize_flat(flat)
+    qtree = unflatten_tree(qflat)
+    # param_pspec keys off path+shape only, so the int8 tree lands in the
+    # exact layout the f32 tree had
+    shardings = shardings_of(mesh, param_specs(qtree, cfg, mesh))
+    qtree = jax.tree.map(jax.device_put, qtree, shardings)
+    sc_sh = scale_shardings(scales, mesh)
+    scales = {k: jax.device_put(v, sc_sh[k]) for k, v in scales.items()}
+    return qtree, scales
+
+
+def topk_accuracy(ids: np.ndarray, labels: np.ndarray) -> Tuple[float, float]:
+    """(top1, top5) from engine predict output ids (n, k) and labels (n,).
+    top5 uses min(5, k) columns — the engine clamps k to num_classes."""
+    labels = np.asarray(labels).reshape(-1, 1)
+    top1 = float(np.mean(ids[:, :1] == labels))
+    top5 = float(np.mean(np.any(ids[:, :min(5, ids.shape[1])] == labels,
+                                axis=1)))
+    return top1, top5
+
+
+def eval_engine(engine, images: np.ndarray, labels: np.ndarray,
+                batch: Optional[int] = None) -> Tuple[float, float]:
+    """Top-1/top-5 of one engine over a fixed (images, labels) set, batched
+    through the same bucketed predict path traffic uses — the serve-side
+    twin of train.loop.eval_on_val's counting."""
+    b = batch or engine.buckets[-1]
+    ids = np.concatenate([
+        engine.predict(images[i:i + b])[0]
+        for i in range(0, images.shape[0], b)], axis=0)
+    return topk_accuracy(ids, labels)
+
+
+def run_quant_gate(engine_f32, engine_q, images: np.ndarray,
+                   labels: np.ndarray, recorder=None) -> dict:
+    """The accuracy gate: quantized vs f32 top-1/top-5 on the same eval set.
+
+    Returns the gate record (top1/top5 per engine, deltas IN POINTS, n,
+    weights dtypes) and, with a Recorder (--metrics_dir), emits it as one
+    kind:"quant_gate" telemetry event — tools/metrics_report.py surfaces the
+    latest. The hard threshold (|delta top1| <= 1.0 points) lives in
+    tests/test_quant.py, where a regression fails CI instead of shipping."""
+    top1_f, top5_f = eval_engine(engine_f32, images, labels)
+    top1_q, top5_q = eval_engine(engine_q, images, labels)
+    gate = {
+        "top1_f32": top1_f, "top5_f32": top5_f,
+        "top1_quant": top1_q, "top5_quant": top5_q,
+        "delta_top1": round(100.0 * (top1_q - top1_f), 4),
+        "delta_top5": round(100.0 * (top5_q - top5_f), 4),
+        "n": int(images.shape[0]),
+        "weights_dtype": engine_q.weights_dtype,
+        "baseline_dtype": engine_f32.weights_dtype,
+    }
+    if recorder is not None:
+        recorder.event("quant_gate", **gate)
+    return gate

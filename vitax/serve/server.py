@@ -440,6 +440,14 @@ def _make_handler(ctx: ServeContext):
                 snap["degraded_seconds"] = round(
                     ctx.brownout.degraded_seconds(), 3)
                 snap["brownout_enters"] = ctx.brownout.enters_total
+                # device-resident weight footprint (vitax/serve/quant.py):
+                # the per-replica HBM number serve_bench and the fleet
+                # router's capacity math read; only-when-reported so
+                # engine-shaped stand-ins without the accounting still serve
+                if hasattr(ctx.engine, "weights_dtype"):
+                    snap["weights_dtype"] = ctx.engine.weights_dtype
+                if hasattr(ctx.engine, "param_bytes"):
+                    snap["param_bytes"] = ctx.engine.param_bytes()
                 self._reply(200, snap)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
