@@ -660,6 +660,8 @@ def bench_e2e(args, metric_stub: str) -> None:
     kw = train_presets(n_dev)[train_preset]
     if args.batch_size:
         kw["batch_size"] = args.batch_size
+    if args.fused_optimizer != "auto":
+        kw["fused_optimizer"] = args.fused_optimizer
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
@@ -673,9 +675,9 @@ def bench_e2e(args, metric_stub: str) -> None:
 
     mesh = build_mesh(cfg)
     model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh))
-    tx, _ = build_optimizer(cfg, max_iteration=10_000)
+    tx, schedule = build_optimizer(cfg, max_iteration=10_000)
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
-    step_fn = make_train_step(cfg, model, tx, mesh, sspecs)
+    step_fn = make_train_step(cfg, model, tx, mesh, sspecs, schedule=schedule)
     rng_key = jax.random.key(1)
     host_cpus = os.cpu_count() or 1
     n_threads = args.data_threads or host_cpus
@@ -804,6 +806,8 @@ def bench_train(args, metric_stub: str) -> None:
         kw["grad_reduce_dtype"] = args.grad_reduce_dtype
     if args.gather_overlap != "auto":
         kw["gather_overlap"] = args.gather_overlap
+    if args.fused_optimizer != "auto":
+        kw["fused_optimizer"] = args.fused_optimizer
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
@@ -815,7 +819,8 @@ def bench_train(args, metric_stub: str) -> None:
                         or args.grad_accum_steps > 1
                         or args.param_gather_dtype is not None
                         or args.grad_reduce_dtype != "float32"
-                        or args.gather_overlap != "auto"))
+                        or args.gather_overlap != "auto"
+                        or args.fused_optimizer != "auto"))
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll, remat_window=args.remat_window,
@@ -823,9 +828,9 @@ def bench_train(args, metric_stub: str) -> None:
 
     mesh = build_mesh(cfg)
     model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh))
-    tx, _ = build_optimizer(cfg, max_iteration=10_000)
+    tx, schedule = build_optimizer(cfg, max_iteration=10_000)
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
-    step_fn = make_train_step(cfg, model, tx, mesh, sspecs)
+    step_fn = make_train_step(cfg, model, tx, mesh, sspecs, schedule=schedule)
 
     sh = NamedSharding(mesh, batch_pspec())
     rng = np.random.default_rng(0)
@@ -863,7 +868,8 @@ def bench_train(args, metric_stub: str) -> None:
     knobs = ("batch_size", "remat_policy", "scan_blocks", "scan_unroll",
              "remat_window", "grad_ckpt", "use_flash_attention",
              "moe_impl", "att_dropout", "grad_accum_steps",
-             "param_gather_dtype", "grad_reduce_dtype", "gather_overlap")
+             "param_gather_dtype", "grad_reduce_dtype", "gather_overlap",
+             "fused_optimizer")
     # compare only like-for-like: a knob change (e.g. the scan->unrolled
     # default flip) must not masquerade as a same-config speedup. Entries
     # written before a knob existed compare at the Config FIELD DEFAULT —
@@ -900,6 +906,7 @@ def bench_train(args, metric_stub: str) -> None:
             "param_gather_dtype": cfg.param_gather_dtype,
             "grad_reduce_dtype": cfg.grad_reduce_dtype,
             "gather_overlap": cfg.gather_overlap,
+            "fused_optimizer": cfg.fused_optimizer,
         })
 
     # optional collective audit: same report as `tools/comm_audit.py --json`,
@@ -949,7 +956,8 @@ def bench_train(args, metric_stub: str) -> None:
                   "grad_accum_steps": cfg.grad_accum_steps,
                   "param_gather_dtype": cfg.resolved_param_gather_dtype,
                   "grad_reduce_dtype": cfg.grad_reduce_dtype,
-                  "gather_overlap": cfg.gather_overlap},
+                  "gather_overlap": cfg.gather_overlap,
+                  "fused_optimizer": cfg.fused_optimizer},
         **({"comm": comm} if comm is not None else {}),
     })
 
@@ -1013,6 +1021,12 @@ def main():
                         "(off = exact pre-overlap schedule; auto = on "
                         "whenever ZeRO-3 + scanned blocks + per-block remat "
                         "are active)")
+    p.add_argument("--fused_optimizer", default="auto",
+                   choices=["auto", "off", "on"],
+                   help="optimizer A/B arm: one-pass Pallas fused clip+AdamW "
+                        "update over the sharded state (off = exact optax "
+                        "chain; auto = on where the kernels lower to real "
+                        "Mosaic, i.e. TPU)")
     p.add_argument("--comm_audit", action="store_true",
                    help="embed the tools/comm_audit.py collective report "
                         "(op/dtype/bytes per step) in the BENCH payload; "

@@ -21,6 +21,7 @@ from vitax.analysis import ast_lint, hlo, rules
 from vitax.analysis.rules import (
     COLLECTIVE_DTYPE,
     DONATION_HONORED,
+    FUSED_OPTIMIZER,
     GATHER_OVERLAP,
     NO_HOST_TRANSFER,
     NO_REPLICATED_LARGE,
@@ -382,6 +383,55 @@ def test_r007_quant_resident_negative():
     assert "no quant scales" in findings[0].message
 
 
+@pytest.fixture(scope="session")
+def fused_program(devices8):
+    return build_train_program(arm_config("fused"), arm="fused")
+
+
+def test_r008_fused_positive(fused_program):
+    from vitax.ops.fused_optimizer import FUSED_KERNEL_NAME
+    assert fused_program.jaxpr, "fused arm must capture the jaxpr artifact"
+    assert fused_program.jaxpr.count(FUSED_KERNEL_NAME) >= 1
+    assert FUSED_OPTIMIZER.check(fused_program, fused_program.config) == []
+
+
+def test_r008_fused_negative_unfused_build(fused_program):
+    """Teeth check: the SAME rule over a deliberately unfused build (the
+    optax-chain jaxpr attached to a fused-on config) must fire BOTH checks —
+    no kernel launch, and the param-sized post-clip temporary chain."""
+    cfg_on = fused_program.config
+    unfused_jaxpr = hlo.train_step_jaxpr(arm_config("zero3"))
+    broken = Program(kind="train", arm="fused", config=cfg_on,
+                     jaxpr=unfused_jaxpr)
+    findings = FUSED_OPTIMIZER.check(broken, cfg_on)
+    msgs = [f.message for f in findings]
+    assert all(f.rule == "VTX-R008" and f.severity == "ERROR"
+               for f in findings)
+    assert any("no fused_adamw_kernel" in m for m in msgs)
+    assert any("param-sized f32 sqrt" in m for m in msgs), msgs
+
+
+def test_r008_missing_artifact_is_a_finding(fused_program):
+    empty = Program(kind="train", arm="fused", config=fused_program.config)
+    findings = FUSED_OPTIMIZER.check(empty, empty.config)
+    assert len(findings) == 1
+    assert "without a traced-jaxpr artifact" in findings[0].message
+
+
+def test_r008_not_applicable_on_cpu_auto():
+    # CPU default (auto -> interpret -> optax chain): the rule must not bind,
+    # keeping every existing arm's rules_ran pin valid
+    assert not FUSED_OPTIMIZER.applies_to(arm_config("zero3"))
+    assert FUSED_OPTIMIZER.applies_to(arm_config("fused"))
+
+
+def test_r008_rules_ran_pin(fused_program):
+    ran, findings = rules.run_rules(fused_program)
+    assert ran == ["VTX-R001", "VTX-R002", "VTX-R003", "VTX-R005",
+                   "VTX-R008"]
+    assert findings == []
+
+
 def test_run_rules_dispatch(overlap_program, serve_program,
                             serve_quant_program):
     ran, findings = rules.run_rules(overlap_program)
@@ -438,6 +488,22 @@ def test_check_invariants_serve_quant_arm(devices8):
     arm = doc["arms"]["serve_quant"]
     assert set(arm) == {"ok", "rules_ran", "findings"}
     assert arm["rules_ran"] == ["VTX-R006", "VTX-R007"]
+    assert arm["findings"] == []
+
+
+def test_check_invariants_fused_arm(devices8):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_invariants.py"),
+         "--arms", "fused", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["errors"] == {}
+    arm = doc["arms"]["fused"]
+    assert set(arm) == {"ok", "rules_ran", "findings"}
+    assert arm["rules_ran"] == ["VTX-R001", "VTX-R002", "VTX-R003",
+                                "VTX-R005", "VTX-R008"]
     assert arm["findings"] == []
 
 

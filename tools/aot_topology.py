@@ -65,11 +65,11 @@ def compile_for_topology(tag: str, topo_name: str, cfg_kw: dict,
         attention_impl = make_attention_impl(cfg, mesh,
                                              force_tpu_kernels=True)
     model = build_model(cfg, attention_impl=attention_impl)
-    tx, _ = build_optimizer(cfg, max_iteration=10_000)
+    tx, schedule = build_optimizer(cfg, max_iteration=10_000)
     state, sspecs, _ = make_train_state(
         cfg, model, tx, mesh, jax.random.key(0), materialize=False)
     n_params = count_params(state.params)
-    step = make_train_step(cfg, model, tx, mesh, sspecs)
+    step = make_train_step(cfg, model, tx, mesh, sspecs, schedule=schedule)
     sh = NamedSharding(mesh, batch_pspec())
     batch = {
         "image": jax.ShapeDtypeStruct(

@@ -24,7 +24,8 @@ for path in vitax/telemetry tools/metrics_report.py \
             vitax/analysis/concurrency.py vitax/telemetry/threads.py \
             tests/test_concurrency_lint.py \
             vitax/serve/fleet/breaker.py tests/test_chaos.py \
-            vitax/serve/quant.py tests/test_quant.py; do
+            vitax/serve/quant.py tests/test_quant.py \
+            vitax/ops/fused_optimizer.py tests/test_fused_optimizer.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
@@ -44,12 +45,12 @@ if [ "${VITAX_LINT_SKIP_CONCURRENCY:-0}" != "1" ]; then
 fi
 
 # compiled-program invariants, fast arm subset (VTX-Rnnn; rules.FAST_ARMS —
-# one train arm exercising every train rule, plus the full-precision and
-# quantized serve arms for R006/R007).
+# one train arm exercising R001-R005, the fused-optimizer arm for R008,
+# plus the full-precision and quantized serve arms for R006/R007).
 # VITAX_LINT_SKIP_INVARIANTS=1 skips on boxes without the jax toolchain.
 if [ "${VITAX_LINT_SKIP_INVARIANTS:-0}" != "1" ]; then
     python tools/check_invariants.py \
-        --arms zero3_overlap serve serve_quant || exit 1
+        --arms zero3_overlap fused serve serve_quant || exit 1
 fi
 
 if ! python -m flake8 --version >/dev/null 2>&1; then

@@ -58,9 +58,9 @@ else:
                              block_q={block_q}, block_k={block_k})
 mesh = build_mesh(cfg)
 model = build_model(cfg, attention_impl=impl)
-tx, _ = build_optimizer(cfg, max_iteration=100)
+tx, schedule = build_optimizer(cfg, max_iteration=100)
 state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
-step = make_train_step(cfg, model, tx, mesh, sspecs)
+step = make_train_step(cfg, model, tx, mesh, sspecs, schedule=schedule)
 sh = NamedSharding(mesh, batch_pspec())
 rng = np.random.default_rng(0)
 batch = {{

@@ -182,6 +182,8 @@ def summarize(path: str) -> dict:
     mfus = [r["mfu"] for r in steps if "mfu" in r]
     waits = [r.get("data_wait_s", 0.0) for r in steps]
     stalls = sorted(r["ckpt_stall_s"] for r in steps if "ckpt_stall_s" in r)
+    opts = sorted(r["opt_update_s"] for r in steps
+                  if r.get("opt_update_s", 0.0) > 0.0)
     # fraction of each recorded step spent waiting on host data (both sides
     # are per-step averages over the same record interval)
     wait_fracs = [r["data_wait_s"] / r["sec_per_iter"] for r in steps
@@ -200,6 +202,13 @@ def summarize(path: str) -> dict:
                              if stalls else None),
         "ckpt_stall_s_p95": (round(percentile(stalls, 0.95), 6)
                              if stalls else None),
+        # fused-optimizer acceptance metric: fenced wall time of the
+        # optimizer-phase probe (records with the probe disabled carry 0
+        # and are excluded)
+        "opt_update_s_p50": (round(percentile(opts, 0.50), 6)
+                             if opts else None),
+        "opt_update_s_p95": (round(percentile(opts, 0.95), 6)
+                             if opts else None),
         "data_wait_fraction": (round(sum(wait_fracs) / len(wait_fracs), 6)
                                if wait_fracs else None),
         # the streaming data plane's acceptance metric (ROADMAP item 3):
@@ -305,6 +314,9 @@ def print_human(summary: dict) -> None:
     if summary.get("ckpt_stall_s_p50") is not None:
         print(f"  ckpt stall: p50 {summary['ckpt_stall_s_p50']:.4f}s  "
               f"p95 {summary['ckpt_stall_s_p95']:.4f}s per step")
+    if summary.get("opt_update_s_p50") is not None:
+        print(f"  opt update: p50 {summary['opt_update_s_p50']:.4f}s  "
+              f"p95 {summary['opt_update_s_p95']:.4f}s per step")
     if summary.get("input_bound") is not None:
         flag = " (!!)" if summary["input_bound"] > 0 else ""
         print(f"  input-bound steps (wait > 10% of step): "

@@ -48,9 +48,9 @@ def build(schedule: str, microbatches: int):
                  grad_ckpt=True).validate()
     mesh = build_mesh(cfg)
     model = build_model(cfg)
-    tx, _ = build_optimizer(cfg, max_iteration=100)
+    tx, schedule = build_optimizer(cfg, max_iteration=100)
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
-    step_fn = make_train_step(cfg, model, tx, mesh, sspecs)
+    step_fn = make_train_step(cfg, model, tx, mesh, sspecs, schedule=schedule)
     sh = NamedSharding(mesh, batch_pspec())
     rng = np.random.default_rng(0)
     batch = {

@@ -45,6 +45,10 @@ def main():
                    help="overlap A/B: prefetch next block-group's ZeRO-3 "
                         "gathers through the scan carry (off = use-site "
                         "gathers, the pre-overlap schedule)")
+    p.add_argument("--fused_optimizer", default="auto",
+                   choices=["auto", "off", "on"],
+                   help="optimizer A/B: one-pass Pallas fused clip+AdamW "
+                        "(off = exact optax chain)")
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -83,6 +87,8 @@ def main():
         kw["grad_reduce_dtype"] = args.grad_reduce_dtype
     if args.gather_overlap != "auto":
         kw["gather_overlap"] = args.gather_overlap
+    if args.fused_optimizer != "auto":
+        kw["fused_optimizer"] = args.fused_optimizer
     cfg = Config(num_classes=1000, warmup_steps=0,
                  remat_policy=args.remat_policy,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,
@@ -90,9 +96,9 @@ def main():
 
     mesh = build_mesh(cfg)
     model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh))
-    tx, _ = build_optimizer(cfg, max_iteration=10_000)
+    tx, schedule = build_optimizer(cfg, max_iteration=10_000)
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
-    step_fn = make_train_step(cfg, model, tx, mesh, sspecs)
+    step_fn = make_train_step(cfg, model, tx, mesh, sspecs, schedule=schedule)
 
     sh = NamedSharding(mesh, batch_pspec())
     rng = np.random.default_rng(0)

@@ -461,14 +461,9 @@ def capture_partitioned(lowered, module_hint: str = "train_step") -> str:
         shutil.rmtree(dump_dir, ignore_errors=True)
 
 
-def lower_train_step(cfg, max_iteration: int = 10_000, donate: bool = True):
-    """AOT-lower the train step for `cfg` on the current backend.
-
-    Returns (lowered, n_state_leaves): the `jax.stages.Lowered` step and the
-    number of TrainState leaves (the donation rule's expected aliased-buffer
-    count). `donate=False` builds the same program without donate_argnums —
-    the deliberately-broken arm the donation rule's negative test compiles.
-    """
+def _build_train_step(cfg, max_iteration: int, donate: bool):
+    """Shared builder for the AOT surfaces: returns
+    (step, (state, batch, rng) abstract args, n_state_leaves)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
@@ -483,11 +478,12 @@ def lower_train_step(cfg, max_iteration: int = 10_000, donate: bool = True):
     mesh = build_mesh(cfg)
     model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh),
                         token_sharding=_token_sharding(cfg, mesh))
-    tx, _ = build_optimizer(cfg, max_iteration=max_iteration)
+    tx, schedule = build_optimizer(cfg, max_iteration=max_iteration)
     state, sspecs, _ = make_train_state(cfg, model, tx, mesh,
                                         jax.random.key(cfg.seed),
                                         materialize=False)
-    step = make_train_step(cfg, model, tx, mesh, sspecs, donate=donate)
+    step = make_train_step(cfg, model, tx, mesh, sspecs, donate=donate,
+                           schedule=schedule)
     sh = NamedSharding(mesh, batch_pspec())
     batch = {
         "image": jax.ShapeDtypeStruct(
@@ -496,9 +492,81 @@ def lower_train_step(cfg, max_iteration: int = 10_000, donate: bool = True):
         "label": jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32,
                                       sharding=sh),
     }
-    lowered = step.lower(state, batch, jax.random.key(cfg.seed + 1))
-    n_state_leaves = len(jax.tree_util.tree_leaves(state))
-    return lowered, n_state_leaves
+    args = (state, batch, jax.random.key(cfg.seed + 1))
+    return step, args, len(jax.tree_util.tree_leaves(state))
+
+
+def lower_train_step(cfg, max_iteration: int = 10_000, donate: bool = True):
+    """AOT-lower the train step for `cfg` on the current backend.
+
+    Returns (lowered, n_state_leaves): the `jax.stages.Lowered` step and the
+    number of TrainState leaves (the donation rule's expected aliased-buffer
+    count). `donate=False` builds the same program without donate_argnums —
+    the deliberately-broken arm the donation rule's negative test compiles.
+    """
+    step, args, n_state_leaves = _build_train_step(cfg, max_iteration, donate)
+    return step.lower(*args), n_state_leaves
+
+
+def train_step_jaxpr(cfg, max_iteration: int = 10_000) -> str:
+    """Trace the train step for `cfg` and return its closed jaxpr as text.
+
+    The jaxpr — not StableHLO — is the artifact the fused-optimizer rule
+    (VTX-R008) reads: Pallas interpret mode (the only lowering available
+    off-TPU in this jax) leaves no custom-call marker in MLIR, but every
+    `pallas_call` jaxpr equation prints the kernel function's name, and the
+    surrounding equations still show any param-sized post-clip temporaries
+    the fusion was supposed to eliminate."""
+    step, args, _ = _build_train_step(cfg, max_iteration, donate=True)
+    return str(step.trace(*args).jaxpr)
+
+
+# `c:f32[256,96] = sqrt b` — binder dtype/shape and primitive name of a jaxpr
+# equation, for the ops VTX-R008 bans at param size outside the fused kernel
+JAXPR_EQN_RE = re.compile(r":f32\[([\d,]*)\] = (sqrt|select_n)\b")
+
+
+def strip_bracketed(text: str, marker: str) -> str:
+    """Remove every `marker[...]` block (bracket-matched, nests fine) from
+    jaxpr text — used to drop `pallas_call[...]` equation params, whose
+    embedded kernel jaxpr would otherwise alias the ops the fused-optimizer
+    rule scans for OUTSIDE the kernel."""
+    out = []
+    i = 0
+    while True:
+        j = text.find(marker + "[", i)
+        if j < 0:
+            out.append(text[i:])
+            return "".join(out)
+        out.append(text[i:j + len(marker)])
+        k = j + len(marker)
+        depth = 0
+        while k < len(text):
+            if text[k] == "[":
+                depth += 1
+            elif text[k] == "]":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        i = k + 1
+
+
+def jaxpr_oversized_eqns(jaxpr_text: str, min_elems: int) -> List[dict]:
+    """Equations (sqrt / select_n, the optax adamw + clip tell-tales) whose
+    f32 output has >= min_elems elements, AFTER stripping pallas_call params.
+    Returns rows {op, shape, numel} for the rule's finding details."""
+    stripped = strip_bracketed(jaxpr_text, "pallas_call")
+    rows = []
+    for m in JAXPR_EQN_RE.finditer(stripped):
+        dims, op = m.group(1), m.group(2)
+        numel = 1
+        for d in dims.split(","):
+            if d:
+                numel *= int(d)
+        if numel >= min_elems:
+            rows.append({"op": op, "shape": dims, "numel": numel})
+    return rows
 
 
 def partitioned_hlo_text(cfg, max_iteration: int = 10_000) -> str:

@@ -45,16 +45,20 @@ class Finding:
 class Program:
     """One lowered program plus the artifacts the rules parse.
 
-    kind "train": `mlir` (lowered StableHLO, always present) and
+    kind "train": `mlir` (lowered StableHLO, always present),
     `partitioned_hlo` (post-SPMD-partitioning dump; "" on single-device
-    meshes where the partitioner never runs). kind "serve": a warmed-up
-    InferenceEngine (the AOT bucket invariants are runtime properties of
-    the executable set, not of any one module's text)."""
+    meshes where the partitioner never runs), and `jaxpr` (traced-jaxpr
+    text, captured only on fused-optimizer arms — interpret-mode Pallas
+    leaves no custom-call marker in MLIR, so VTX-R008 reads the jaxpr).
+    kind "serve": a warmed-up InferenceEngine (the AOT bucket invariants
+    are runtime properties of the executable set, not of any one module's
+    text)."""
     kind: str                     # "train" | "serve"
     arm: str
     config: Config
     mlir: str = ""
     partitioned_hlo: str = ""
+    jaxpr: str = ""
     mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
     n_state_leaves: int = 0
     engine: Any = None
@@ -364,6 +368,51 @@ def check_quant_weights_resident(program: Program, cfg: Config) -> List[Finding]
     return out
 
 
+def _fused_active(cfg: Config) -> bool:
+    """Config-side gate for VTX-R008: the resolved --fused_optimizer policy
+    (lazy import — rules.py stays importable without pulling in jax)."""
+    from vitax.ops.fused_optimizer import fused_optimizer_active
+    return fused_optimizer_active(cfg)
+
+
+@rule("VTX-R008", "fused-optimizer-lowered", "ERROR", ("train",),
+      "with the fused optimizer active the traced train step must actually "
+      "launch the fused AdamW Pallas kernel AND leave no post-clip "
+      "param-sized f32 temporary chain: sqrt / select_n equations at "
+      "parameter size outside the kernel are the optax adamw / per-leaf "
+      "clip tell-tales of the one-pass update silently regressing to the "
+      "tree-of-ops chain (same perf-properties-are-CI discipline as "
+      "R004/R007)",
+      applies_to=_fused_active)
+def check_fused_optimizer(program: Program, cfg: Config) -> List[Finding]:
+    r = FUSED_OPTIMIZER
+    from vitax.ops.fused_optimizer import FUSED_KERNEL_NAME
+    if not program.jaxpr:
+        return [_finding(
+            r, program,
+            "fused-optimizer arm lowered without a traced-jaxpr artifact — "
+            "the rule has nothing to audit (build_train_program captures "
+            "Program.jaxpr whenever the fused policy resolves on)")]
+    out: List[Finding] = []
+    n_launches = program.jaxpr.count(FUSED_KERNEL_NAME)
+    if n_launches == 0:
+        out.append(_finding(
+            r, program,
+            f"traced train step contains no {FUSED_KERNEL_NAME} pallas_call "
+            f"— the fused optimizer did not enter the compiled program",
+            kernel=FUSED_KERNEL_NAME))
+    min_elems = large_param_threshold_bytes(cfg) // 4  # f32 elements
+    for row in hlo.jaxpr_oversized_eqns(program.jaxpr, min_elems):
+        out.append(_finding(
+            r, program,
+            f"param-sized f32 {row['op']} over [{row['shape']}] "
+            f"({row['numel']:,} elems) outside the fused kernel — an "
+            f"optimizer temporary the one-pass update should have "
+            f"eliminated",
+            eqn=row, min_elems=min_elems))
+    return out
+
+
 NO_HOST_TRANSFER = RULES[0]
 DONATION_HONORED = RULES[1]
 COLLECTIVE_DTYPE = RULES[2]
@@ -371,6 +420,7 @@ GATHER_OVERLAP = RULES[3]
 NO_REPLICATED_LARGE = RULES[4]
 SERVE_NO_RECOMPILE = RULES[5]
 QUANT_WEIGHTS_RESIDENT = RULES[6]
+FUSED_OPTIMIZER = RULES[7]
 
 
 def rules_for(program: Program) -> List[Rule]:
@@ -410,6 +460,9 @@ TRAIN_ARMS: Dict[str, dict] = {
     "zero3_overlap": dict(gather_overlap="on"),
     "accum": dict(batch_size=128, grad_accum_steps=2),
     "moe": dict(moe_experts=4, gather_overlap="off"),
+    # forced fused optimizer (interpret-mode Pallas on CPU) — the arm that
+    # activates VTX-R008 and captures the traced-jaxpr artifact
+    "fused": dict(gather_overlap="off", fused_optimizer="on"),
 }
 
 SERVE_ARM = "serve"
@@ -419,8 +472,9 @@ SERVE_ARM = "serve"
 SERVE_QUANT_ARM = "serve_quant"
 ALL_ARMS = tuple(TRAIN_ARMS) + (SERVE_ARM, SERVE_QUANT_ARM)
 # the lint.sh / pre-push subset: one train arm covering R001-R005 (the
-# overlap arm applies every train rule) plus both serve arms for R006/R007
-FAST_ARMS = ("zero3_overlap", SERVE_ARM, SERVE_QUANT_ARM)
+# overlap arm applies every train rule), the fused arm for R008, plus both
+# serve arms for R006/R007
+FAST_ARMS = ("zero3_overlap", "fused", SERVE_ARM, SERVE_QUANT_ARM)
 
 
 def arm_config(arm: str, **overrides) -> Config:
@@ -437,7 +491,7 @@ def arm_config(arm: str, **overrides) -> Config:
 
 def build_train_program(cfg: Config, arm: str = "custom",
                         donate: bool = True) -> Program:
-    """Lower the train step for `cfg` and capture both rule artifacts."""
+    """Lower the train step for `cfg` and capture the rule artifacts."""
     from vitax.parallel.mesh import build_mesh
     lowered, n_state_leaves = hlo.lower_train_step(cfg, donate=donate)
     mesh = build_mesh(cfg)
@@ -445,6 +499,8 @@ def build_train_program(cfg: Config, arm: str = "custom",
         kind="train", arm=arm, config=cfg,
         mlir=lowered.as_text(),
         partitioned_hlo=hlo.capture_partitioned(lowered),
+        # the traced-jaxpr artifact only exists where a rule reads it
+        jaxpr=hlo.train_step_jaxpr(cfg) if _fused_active(cfg) else "",
         mesh_shape=dict(mesh.shape),
         n_state_leaves=n_state_leaves,
     )

@@ -115,6 +115,13 @@ class Config:
     #   schedule cannot serve: pp, ZeRO-2/DP, unscanned blocks, no-remat).
     gather_overlap: str = "auto"        # auto | off | on
     use_flash_attention: bool = True    # Pallas flash-attention kernel on TPU (jnp fallback elsewhere)
+    # Fused clip+AdamW optimizer (vitax/ops/fused_optimizer.py): one Pallas
+    #   pass over the sharded state instead of the optax tree-of-ops. auto =
+    #   on exactly when the kernels lower to real Mosaic (TPU backend, or
+    #   VITAX_FORCE_MOSAIC=1 AOT compiles); on = force it anywhere (Pallas
+    #   interpret mode off-TPU — the CI equivalence arms); off = the exact
+    #   optax chain.
+    fused_optimizer: str = "auto"       # auto | off | on
     # Mesh: (dp, fsdp, tp, sp). -1 on fsdp means "all remaining devices".
     dp_size: int = 1
     fsdp_size: int = -1
@@ -284,6 +291,9 @@ class Config:
             f"--grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
         assert self.gather_overlap in ("auto", "off", "on"), (
             f"unknown gather_overlap {self.gather_overlap!r} "
+            f"(expected 'auto', 'off' or 'on')")
+        assert self.fused_optimizer in ("auto", "off", "on"), (
+            f"unknown fused_optimizer {self.fused_optimizer!r} "
             f"(expected 'auto', 'off' or 'on')")
         if self.gather_overlap == "on":
             assert self.pp_size == 1, (
@@ -588,6 +598,15 @@ def build_parser() -> argparse.ArgumentParser:
                           "blocks + none_saveable remat; off = the exact "
                           "pre-overlap program; on = require it (rejected "
                           "under pp / ZeRO-2 / DP / --no_scan_blocks).")
+    ext.add_argument("--fused_optimizer", type=str, default="auto",
+                     choices=["auto", "off", "on"],
+                     help="fused clip+AdamW Pallas kernel over the sharded "
+                          "state (vitax/ops/fused_optimizer.py): one launch "
+                          "per leaf group writing (param, mu, nu) in place. "
+                          "auto (default) = on when the kernels lower to real "
+                          "Mosaic (TPU / VITAX_FORCE_MOSAIC); on = force it "
+                          "anywhere (interpret mode off-TPU); off = the "
+                          "exact optax chain.")
     ext.add_argument("--grad_accum_steps", type=int, default=1)
     ext.add_argument("--dtype", type=str, default="bfloat16", choices=["bfloat16", "float32"])
     ext.add_argument("--param_gather_dtype", type=str, default=None,

@@ -5,7 +5,7 @@ versioned, machine-readable record (schema 1):
 
     schema, time, step, epoch, step_in_epoch, loss, lr, grad_norm,
     sec_per_iter, images_per_sec, tokens_per_sec, data_wait_s, ckpt_stall_s,
-    mfu, mem_used_bytes, mem_peak_bytes[, mem_limit_bytes]
+    opt_update_s, mfu, mem_used_bytes, mem_peak_bytes[, mem_limit_bytes]
 
 MFU comes from the analytic FLOPs model (telemetry/flops.py) over the
 measured sec/iter — no device work, no tracing. `event()` appends
@@ -63,14 +63,17 @@ class Recorder:
     def record_step(self, *, step: int, epoch: int, step_in_epoch: int,
                     loss: float, lr: float, sec_per_iter: float,
                     data_wait_s: float, grad_norm: Optional[float] = None,
-                    ckpt_stall_s: float = 0.0,
+                    ckpt_stall_s: float = 0.0, opt_update_s: float = 0.0,
                     ) -> dict:
         """One record per log step. `sec_per_iter` / `data_wait_s` /
         `ckpt_stall_s` are the per-step averages since the previous record;
         `step` is the global optimizer-step count (monotonically increasing
         across epochs). `ckpt_stall_s` is the zero-stall snapshot pipeline's
         staging time charged to the loop thread (vitax/checkpoint/
-        snapshot.py) — the acceptance pin keeps it ~0 on non-final saves."""
+        snapshot.py) — the acceptance pin keeps it ~0 on non-final saves.
+        `opt_update_s` is the fenced wall time of the optimizer-phase probe
+        (vitax/train/step.py make_opt_probe), measured at log steps only —
+        the fused-optimizer win as a number, not an assertion."""
         record = {
             "schema": SCHEMA_VERSION,
             "time": time.time(),
@@ -86,6 +89,7 @@ class Recorder:
                                if sec_per_iter > 0 else 0.0),
             "data_wait_s": float(data_wait_s),
             "ckpt_stall_s": float(ckpt_stall_s),
+            "opt_update_s": float(opt_update_s),
             "mfu": mfu(self.cfg, sec_per_iter, self.n_devices,
                        self.peak_tflops),
         }

@@ -28,7 +28,7 @@ from vitax.models import build_model, count_params
 from vitax.parallel.mesh import BATCH_AXES, build_mesh
 from vitax.train.control import ControlPlane
 from vitax.train.state import TrainState, build_optimizer, make_train_state
-from vitax.train.step import make_eval_step, make_train_step
+from vitax.train.step import make_eval_step, make_opt_probe, make_train_step
 from vitax.telemetry import (Watchdog, build_recorder,
                              install_thread_excepthook)
 from vitax.telemetry.watchdog import EXIT_HANG
@@ -232,7 +232,8 @@ def train(cfg: Config) -> TrainState:
             f"grad accumulation: {cfg.grad_accum_steps} microbatches of "
             f"{cfg.batch_size // cfg.grad_accum_steps} inside the jitted "
             f"step (one optimizer step per loader batch)")
-    train_step = make_train_step(cfg, model, tx, mesh, state_specs)
+    train_step = make_train_step(cfg, model, tx, mesh, state_specs,
+                                 schedule=schedule)
     eval_step = make_eval_step(cfg, model, mesh, state_specs)
 
     smoothed_loss = SmoothedValue(window_size=5)
@@ -250,6 +251,22 @@ def train(cfg: Config) -> TrainState:
     # rank-tagged stderr tracebacks + kind:"thread_crash" events instead
     # of silent thread deaths (recorder=None still tags stderr)
     install_thread_excepthook(recorder, rank=jax.process_index())
+    # opt_update_s probe: a separate non-donating compile of the optimizer
+    # phase (vitax/train/step.py make_opt_probe), run at log steps only — the
+    # train step's program and the non-log-step cadence are untouched. The
+    # first probe call warms the compile; timing starts at the second.
+    opt_probe = (make_opt_probe(cfg, tx, mesh, state_specs, schedule=schedule)
+                 if recorder is not None else None)
+    opt_probe_warm = [False]
+
+    def _time_opt_update(cur_state) -> float:
+        if not opt_probe_warm[0]:
+            jax.block_until_ready(opt_probe(cur_state))
+            opt_probe_warm[0] = True
+        t0 = time.perf_counter()
+        jax.block_until_ready(opt_probe(cur_state))
+        return time.perf_counter() - t0
+
     if recorder is not None:
         master_print(f"telemetry: JSONL step records -> {cfg.metrics_dir} "
                      f"(MFU vs {recorder.peak_tflops:.0f} TF/s/chip peak"
@@ -347,7 +364,8 @@ def train(cfg: Config) -> TrainState:
             schedule, smoothed_loss, smoothed_time, prof,
             resume_step=resume_step, resume_rounded=resume_rounded,
             recorder=recorder, watchdog=watchdog, control=control,
-            snap_pipe=snap_pipe, replicator=replicator)
+            snap_pipe=snap_pipe, replicator=replicator,
+            opt_timer=_time_opt_update if opt_probe is not None else None)
     except Exception as e:  # noqa: BLE001 — classify, then exit coordinated or re-raise
         # A dead peer shows up two ways: ICI collectives BLOCK on it (the
         # liveness deadline timer bounds that), host-plane transports like
@@ -482,7 +500,7 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 schedule, smoothed_loss, smoothed_time, prof,
                 resume_step: int = 0, resume_rounded: bool = False,
                 recorder=None, watchdog=None, control=None,
-                snap_pipe=None, replicator=None):
+                snap_pipe=None, replicator=None, opt_timer=None):
     if control is None:  # direct callers (tests): a local, collective-free plane
         control = ControlPlane(sync_steps=cfg.control_sync_steps,
                                watchdog=watchdog)
@@ -588,6 +606,11 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                         ckpt_stall_s=((snap_pipe.consume_stall_s()
                                        / max(steps_since_record, 1))
                                       if snap_pipe is not None else 0.0),
+                        # fenced re-run of the optimizer phase in isolation
+                        # (probe program, not the train step) — the cost
+                        # rides a log step that just fenced anyway
+                        opt_update_s=(opt_timer(state)
+                                      if opt_timer is not None else 0.0),
                         grad_norm=float(jax.device_get(metrics["grad_norm"])))
                 steps_since_record = 0
             if (replicator is not None and snap_pipe is not None

@@ -41,14 +41,20 @@ def build_optimizer(cfg: Config, max_iteration: int) -> Tuple[optax.GradientTran
     - clip BEFORE the update (reference clips grads then steps,
       run_vit_training.py:266-278); clipping by *global* norm of sharded grads
       is exact under jit — the norm is computed with a compiled all-reduce,
-      which is what FSDP's model.clip_grad_norm_ does by hand (run_vit_training.py:270)
+      which is what FSDP's model.clip_grad_norm_ does by hand (run_vit_training.py:270).
+      The clip itself is applied in the train step (vitax/train/step.py),
+      bitwise-reproducing optax.clip_by_global_norm's formula off the SAME
+      global-norm reduction that feeds the grad_norm metric — one norm pass
+      per step instead of two. The chain keeps an optax.identity() in the
+      clip's historical slot so the opt_state tree (and with it state_specs,
+      checkpoints, and donation) is unchanged: both lower to EmptyState.
     - AdamW betas (0.9, 0.999), eps 1e-8, weight decay on ALL params
       (torch.optim.AdamW semantics, reference run_vit_training.py:237)
     """
     schedule = warmup_cosine_schedule(cfg.lr, cfg.warmup_steps, max_iteration)
     parts = []
     if cfg.clip_grad_norm > 0:
-        parts.append(optax.clip_by_global_norm(cfg.clip_grad_norm))
+        parts.append(optax.identity())
     parts.append(
         optax.adamw(schedule, weight_decay=cfg.weight_decay, **ADAMW_HPARAMS))
     return optax.chain(*parts), schedule

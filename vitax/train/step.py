@@ -18,10 +18,11 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from vitax.config import Config
+from vitax.ops.fused_optimizer import fused_clip_adamw, fused_optimizer_active
 from vitax.parallel.mesh import BATCH_AXES, Mesh, batch_pspec
 from vitax.parallel.sharding import (
     gather_over_fsdp, gather_overlap_active, make_comm_precision, shardings_of)
-from vitax.train.state import TrainState
+from vitax.train.state import ADAMW_HPARAMS, TrainState
 
 PyTree = Any
 
@@ -144,6 +145,85 @@ def _microbatch_split(batch: PyTree, k_steps: int, mesh: Mesh) -> PyTree:
     return jax.tree.map(split, batch)
 
 
+def _make_update_fn(cfg: Config, tx, mesh: Mesh, state_specs, schedule):
+    """The optimizer phase: update(grads, opt_state, params) ->
+    (new_params, new_opt_state, grad_norm). Shared by the train step and the
+    opt_update_s telemetry probe (make_opt_probe).
+
+    ONE global-norm reduction per step feeds both the clip and the grad_norm
+    metric (the old step re-reduced the tree optax's clip_by_global_norm had
+    already walked). The clip applies optax's exact formula off that shared
+    norm, so the value chain is bit-identical to the chained transform.
+
+    With the fused optimizer active (vitax/ops/fused_optimizer.py), clip +
+    AdamW + weight decay + param step run as one Pallas pass per leaf group,
+    in place, shard-local under the FSDP specs."""
+    fused = fused_optimizer_active(cfg)
+    if fused and schedule is None:
+        raise ValueError(
+            "fused optimizer is active but no lr schedule was provided — "
+            "pass build_optimizer's second return value as schedule=")
+
+    def update(grads, opt_state, params):
+        grad_norm = optax.global_norm(grads)
+        if fused:
+            new_params, new_opt_state = fused_clip_adamw(
+                grads, opt_state, params,
+                grad_norm=grad_norm,
+                schedule=schedule,
+                clip_norm=cfg.clip_grad_norm,
+                weight_decay=cfg.weight_decay,
+                mesh=mesh if mesh.size > 1 else None,
+                param_specs=state_specs.params,
+                **ADAMW_HPARAMS)
+            return new_params, new_opt_state, grad_norm
+        if cfg.clip_grad_norm > 0:
+            # optax.clip_by_global_norm's update_fn, verbatim, off the
+            # shared reduction
+            trigger = jnp.squeeze(grad_norm < cfg.clip_grad_norm)
+            grads = jax.tree.map(
+                lambda t: jax.lax.select(
+                    trigger, t,
+                    (t / grad_norm.astype(t.dtype)) * cfg.clip_grad_norm),
+                grads)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state, grad_norm
+
+    return update
+
+
+def make_opt_probe(
+    cfg: Config,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_specs: PyTree,
+    schedule=None,
+):
+    """Jitted optimizer-phase probe for the opt_update_s telemetry:
+    (state) -> (new_params, new_opt_state, grad_norm) over all-zero grads at
+    the state shardings — the same update program the train step runs, timed
+    in isolation. A SEPARATE, non-donating compile: the train step's program
+    is untouched (tests/test_telemetry.py pins its identity), the probe's
+    outputs are discarded, and the loop invokes it at log steps only."""
+    state_shardings = shardings_of(mesh, state_specs)
+    update_fn = _make_update_fn(cfg, tx, mesh, state_specs, schedule)
+
+    def probe(state: TrainState):
+        grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             state.params)
+        if mesh.size > 1:
+            grads = jax.lax.with_sharding_constraint(
+                grads, shardings_of(mesh, state_specs.params))
+        return update_fn(grads, state.opt_state, state.params)
+
+    return jax.jit(
+        probe,
+        in_shardings=(state_shardings,),
+        out_shardings=(state_shardings.params, state_shardings.opt_state,
+                       None),
+    )
+
+
 def make_train_step(
     cfg: Config,
     model,
@@ -151,6 +231,7 @@ def make_train_step(
     mesh: Mesh,
     state_specs: PyTree,
     donate: bool = True,
+    schedule=None,
 ) -> Callable[[TrainState, Dict[str, jax.Array], jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step: (state, batch, rng) -> (state, metrics).
 
@@ -158,6 +239,10 @@ def make_train_step(
       `donate=False` exists for the program-invariant verifier only
       (vitax/analysis/rules.py donation-honored rule compiles it as the
       deliberately-broken negative arm); production callers always donate.
+    - `schedule` is build_optimizer's second return value (the pure lr
+      schedule). Required when the fused optimizer is active — the fused
+      path evaluates it directly instead of optax's scale_by_schedule; the
+      optax path ignores it.
     - ZeRO-2 mode (`--no_reshard_after_forward`): params are constrained to a
       fully-gathered (over "fsdp") layout at the top of the step, so the
       all-gather happens once and the gathered weights stay live through
@@ -186,6 +271,7 @@ def make_train_step(
     dropout = _needs_dropout(cfg)
     forward = _forward_fn(cfg, model, mesh, state_specs)
     comm = make_comm_precision(cfg, mesh, state_specs.params)
+    update_fn = _make_update_fn(cfg, tx, mesh, state_specs, schedule)
 
     moe = cfg.moe_experts > 0
     anchor_logits = _make_logits_anchor(mesh)
@@ -348,13 +434,14 @@ def make_train_step(
             loss, grads = jax.value_and_grad(loss_fn)(params, batch, step_rng)
         if comm is not None:
             grads = comm.finalize_grads(grads)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        new_params, new_opt_state, grad_norm = update_fn(
+            grads, state.opt_state, state.params)
         new_state = TrainState(
             step=state.step + 1, params=new_params, opt_state=new_opt_state)
         metrics = {
             "loss": loss,
-            "grad_norm": optax.global_norm(grads),
+            # the same reduction that fed the clip — not a second pass
+            "grad_norm": grad_norm,
             # post-step schedule position: the reference logs lr AFTER
             # lr_scheduler.step() (run_vit_training.py:288); the host resolves
             # the value via the pure schedule fn
@@ -384,6 +471,7 @@ def make_train_step(
         return new_state, metrics
 
     step_with_counts.lower = jitted.lower  # AOT surface (tools/, tests/)
+    step_with_counts.trace = jitted.trace  # jaxpr surface (VTX-R008)
     return step_with_counts
 
 
