@@ -21,6 +21,7 @@ from vitax.analysis import ast_lint, hlo, rules
 from vitax.analysis.rules import (
     COLLECTIVE_DTYPE,
     DONATION_HONORED,
+    FUSED_DEQUANT,
     FUSED_OPTIMIZER,
     GATHER_OVERLAP,
     NO_HOST_TRANSFER,
@@ -383,6 +384,134 @@ def test_r007_quant_resident_negative():
     assert "no quant scales" in findings[0].message
 
 
+# --- tier 2: fp8 arm + fused dequant-matmul (VTX-R009) -----------------------
+
+
+@pytest.fixture(scope="session")
+def serve_fp8_program(devices8):
+    return build_serve_program(arm_config("serve_fp8"), arm="serve_fp8")
+
+
+@pytest.fixture(scope="session")
+def serve_actquant_program(devices8):
+    return build_serve_program(
+        arm_config("serve_actquant"), arm="serve_actquant")
+
+
+def test_r007_fp8_positive(serve_fp8_program):
+    """R007 is dtype-keyed: the fp8 arm passes the same residency/arg checks
+    against float8_e4m3 leaves and f8E4M3 program arguments."""
+    import ml_dtypes
+    import numpy as np
+    prog = serve_fp8_program
+    assert prog.engine.weights_dtype == "float8_e4m3"
+    assert QUANT_WEIGHTS_RESIDENT.applicable(prog)
+    assert QUANT_WEIGHTS_RESIDENT.check(prog, prog.config) == []
+    assert SERVE_NO_RECOMPILE.check(prog, prog.config) == []
+    fp8 = np.dtype(ml_dtypes.float8_e4m3)
+    import jax
+    fp8_leaves = [v for v in jax.tree.leaves(prog.engine.params)
+                  if np.dtype(v.dtype) == fp8]
+    assert len(fp8_leaves) == len(prog.engine.scales)
+
+
+def test_r007_fp8_negative_int8_leaves():
+    """Wrong quant dtype on device (int8 leaves under an fp8 config) trips
+    both the residency check and the program-argument count."""
+    import numpy as np
+    cfg = arm_config("serve_fp8")
+    d = cfg.embed_dim
+
+    class WrongDtypeEngine:
+        buckets = (1, 2, 4)
+        scales = {"params/blocks/mlp/fc1/kernel": np.ones((1, 1, d * 4),
+                                                          np.float32)}
+        params = {"params": {"blocks": {"mlp": {"fc1": {
+            "kernel": np.zeros((2, d, d * 4), np.int8)}}}}}
+
+        def lower_bucket_mlir(self, bucket):
+            return mk_mlir([(f"tensor<2x{d}x{d * 4}xi8>", SHARDED)])
+
+    broken = Program(kind="serve", arm="serve_fp8", config=cfg,
+                     engine=WrongDtypeEngine())
+    findings = QUANT_WEIGHTS_RESIDENT.check(broken, cfg)
+    msgs = [f.message for f in findings]
+    assert any("not float8_e4m3" in m for m in msgs)
+    assert any("0 f8E4M3 arguments for 1 scaled leaves" in m for m in msgs)
+
+
+def test_r009_fused_positive(serve_actquant_program):
+    from vitax.ops.dequant_matmul import DEQUANT_KERNEL_NAME
+    prog = serve_actquant_program
+    assert prog.engine.fused_dequant is True
+    assert FUSED_DEQUANT.applicable(prog)
+    jaxpr = prog.engine.trace_bucket_jaxpr(prog.engine.buckets[-1])
+    assert jaxpr.count(DEQUANT_KERNEL_NAME) >= 1
+    assert FUSED_DEQUANT.check(prog, prog.config) == []
+
+
+def test_r009_negative_unfused_build(serve_quant_program,
+                                     serve_actquant_program):
+    """Teeth check: the SAME rule over a deliberately unfused serve engine
+    (the weight-only dequantize_tree program attached to a fused-on config)
+    must fire BOTH checks — no kernel launch, and the weight-sized i8->f32
+    converts at the top level of the traced program."""
+    cfg_on = serve_actquant_program.config
+    broken = Program(kind="serve", arm="serve_actquant", config=cfg_on,
+                     engine=serve_quant_program.engine)
+    findings = FUSED_DEQUANT.check(broken, cfg_on)
+    msgs = [f.message for f in findings]
+    assert all(f.rule == "VTX-R009" and f.severity == "ERROR"
+               for f in findings)
+    assert any("no dequant_matmul_kernel" in m for m in msgs)
+    assert any("weight-sized dequant outside the fused kernel" in m
+               for m in msgs), msgs
+
+
+def test_r009_not_applicable_without_fused():
+    # weight-only int8 (fused auto resolves off on CPU) and the fp8 arm:
+    # the rule must not bind, keeping the serve rules_ran pins stable
+    assert not FUSED_DEQUANT.applies_to(arm_config("serve_quant"))
+    assert not FUSED_DEQUANT.applies_to(arm_config("serve_fp8"))
+    assert FUSED_DEQUANT.applies_to(arm_config("serve_actquant"))
+
+
+def test_tier2_serve_rules_ran_pins(serve_fp8_program,
+                                    serve_actquant_program):
+    ran8, findings8 = rules.run_rules(serve_fp8_program)
+    assert ran8 == ["VTX-R006", "VTX-R007"] and findings8 == []
+    ran_a, findings_a = rules.run_rules(serve_actquant_program)
+    assert ran_a == ["VTX-R006", "VTX-R007", "VTX-R009"]
+    assert findings_a == []
+
+
+def test_jaxpr_quant_dequant_converts_unit():
+    """Parser unit for the R009 helper: sub-jaxpr bodies are stripped (no
+    var shadowing), only i8/f8-sourced converts count (u8 images never
+    do), and the exempt-shape and min-elems filters apply."""
+    text = textwrap.dedent("""\
+        { lambda ; a:i8[2,32,96] b:u8[4,16,16,3] c:f8_e4m3[32,4] d:i8[8,8,3,32]
+            e:i8[2,2]. let
+            f:f32[2,32,96] = convert_element_type[new_dtype=float32] a
+            g:f32[4,16,16,3] = convert_element_type[new_dtype=float32] b
+            h:f32[32,4] = convert_element_type[new_dtype=float32] c
+            i:f32[8,8,3,32] = convert_element_type[new_dtype=float32] d
+            j:f32[2,2] = convert_element_type[new_dtype=float32] e
+            k:f32[2,32,96] = pjit[
+              jaxpr={ lambda ; a:f32[2,32,96]. let
+                  b:f32[2,32,96] = mul a 2.0
+                in (b,) }
+            ] f
+          in (k,) }
+        """)
+    rows = hlo.jaxpr_quant_dequant_converts(
+        text, min_elems=128, exempt_shapes=((8, 8, 3, 32),))
+    # a (i8, 6144 elems) and c (f8, 128 elems) fire; b is u8 (image), d is
+    # the exempt conv shape, e is sub-threshold
+    assert [(r["src_dtype"], tuple(r["shape"])) for r in rows] == [
+        ("i8", (2, 32, 96)), ("f8_e4m3", (32, 4))]
+
+
 @pytest.fixture(scope="session")
 def fused_program(devices8):
     return build_train_program(arm_config("fused"), arm="fused")
@@ -489,6 +618,23 @@ def test_check_invariants_serve_quant_arm(devices8):
     assert set(arm) == {"ok", "rules_ran", "findings"}
     assert arm["rules_ran"] == ["VTX-R006", "VTX-R007"]
     assert arm["findings"] == []
+
+
+def test_check_invariants_tier2_serve_arms(devices8):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_invariants.py"),
+         "--arms", "serve_fp8", "serve_actquant", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["errors"] == {}
+    arm8 = doc["arms"]["serve_fp8"]
+    assert arm8["rules_ran"] == ["VTX-R006", "VTX-R007"]
+    assert arm8["findings"] == []
+    arm_a = doc["arms"]["serve_actquant"]
+    assert arm_a["rules_ran"] == ["VTX-R006", "VTX-R007", "VTX-R009"]
+    assert arm_a["findings"] == []
 
 
 def test_check_invariants_fused_arm(devices8):

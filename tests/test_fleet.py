@@ -597,9 +597,13 @@ def test_fleet_metrics_aggregation(fleet_factory):
                              "replica_restarts": 0, "degraded": 0,
                              "degraded_seconds": 0.0,
                              # weight footprint summed over the replicas
-                             # that report it, dtype set for mixed rollouts
+                             # that report it, dtype/mode sets for mixed
+                             # rollouts (replicas without the tier-2 keys
+                             # aggregate at the defaults)
                              "param_bytes": 2000,
-                             "weights_dtypes": ["int8"]}
+                             "weights_dtypes": ["int8"],
+                             "act_quants": ["off"],
+                             "fused_dequants": ["False"]}
     assert set(snap["replicas"]) == {"a", "b"}
     total = 0
     for name, rsnap in snap["replicas"].items():

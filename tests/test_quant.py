@@ -90,7 +90,9 @@ def test_manifest_schema_and_scales(quant_stack):
     with np.load(int8_path) as data:
         doc = json.loads(str(data[QUANT_MANIFEST_KEY]))
     assert doc["schema"] == QUANT_SCHEMA_VERSION
-    assert set(doc["dtypes"]) == {"int8"}  # float8_e4m3 slot stays empty
+    # dtype-keyed manifest: an int8 export names only the int8 slot (the
+    # fp8 arm is pinned separately in the fp8_stack tests)
+    assert set(doc["dtypes"]) == {"int8"}
     assert doc["dtypes"]["int8"] == sorted(doc["dtypes"]["int8"])
     for key, dtype in manifest.items():
         assert dtype == "int8"
@@ -258,7 +260,8 @@ def test_quant_gate_within_threshold_and_reported(quant_stack, tmp_path):
         [sys.executable, os.path.join(REPO, "tools", "metrics_report.py"),
          jsonl], capture_output=True, text=True, timeout=60)
     assert proc.returncode == 2
-    assert "quant gate (int8 vs float32)" in proc.stdout
+    assert ("quant gate (int8 vs float32, act_quant off, "
+            "fused_dequant False)" in proc.stdout)
 
 
 # --- /metrics footprint keys -------------------------------------------------
@@ -286,6 +289,194 @@ def test_server_metrics_report_weight_footprint(quant_stack):
         finally:
             sys.path.pop(0)
         assert weights == {"param_bytes": engine_q.param_bytes(),
-                           "weights_dtype": "int8"}
+                           "weights_dtype": "int8",
+                           "act_quant": "off",
+                           "fused_dequant": False}
     finally:
         stop_server(httpd, ctx)
+
+
+# --- tier 2: fp8 weight arm --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fp8_stack(quant_stack):
+    """(cfg_fp8, engine_fp8, fp8_path) — the float8_e4m3 export of the same
+    trained checkpoint the int8 stack serves."""
+    from vitax.checkpoint.consolidate import consolidate
+    from vitax.serve import InferenceEngine
+    *_, f32_path, _ = quant_stack
+    root = os.path.dirname(f32_path)
+    ckpt_dir = os.path.join(root, "ckpt")
+    fp8_path = os.path.join(root, "fp8.npz")
+    consolidate(ckpt_dir, 1, fp8_path, dtype="float8_e4m3")
+    cfg8 = tiny_cfg(serve_quant_dtype="float8_e4m3")
+    engine8 = InferenceEngine.from_npz(cfg8, fp8_path)
+    engine8.warmup()
+    return cfg8, engine8, fp8_path
+
+
+def test_fp8_manifest_and_leaf_dtypes(fp8_stack):
+    import ml_dtypes
+    from vitax.checkpoint.consolidate import load_npz_raw
+    _, _, fp8_path = fp8_stack
+    flat, scales, manifest = load_npz_raw(fp8_path)
+    assert manifest and set(manifest.values()) == {"float8_e4m3"}
+    assert set(manifest) == set(scales)
+    for key in manifest:
+        assert flat[key].dtype == ml_dtypes.float8_e4m3
+        s = scales[key]
+        assert s.dtype == np.float32 and s.ndim == flat[key].ndim
+        np.broadcast_shapes(s.shape, flat[key].shape)
+        # absmax/240 scaling: no value leaves the e4m3 range (no inf/nan)
+        back = flat[key].astype(np.float32)
+        assert np.all(np.isfinite(back)) and np.abs(back).max() <= 240.0
+
+
+def test_fp8_engine_contract_and_bytes(quant_stack, fp8_stack):
+    import ml_dtypes
+    _, engine_f, _, _, _, _ = quant_stack
+    _, engine8, _ = fp8_stack
+    assert engine8.buckets == engine_f.buckets
+    assert engine8.compile_count == len(engine8.buckets)
+    assert engine8.quantized and engine8.weights_dtype == "float8_e4m3"
+    # the fp8 acceptance floor: <= 0.35x the f32 device-resident bytes at
+    # this geometry (1-byte weights + f32 scales/LN/bias residue)
+    assert engine8.param_bytes() <= 0.35 * engine_f.param_bytes(), (
+        engine8.param_bytes(), engine_f.param_bytes())
+    fp8_leaves = [v for v in jax.tree.leaves(engine8.params)
+                  if v.dtype == ml_dtypes.float8_e4m3]
+    assert fp8_leaves and len(fp8_leaves) == len(engine8.scales)
+
+
+def test_fp8_deterministic_and_zero_recompile(fp8_stack):
+    from vitax.serve import InferenceEngine
+    cfg8, engine8, fp8_path = fp8_stack
+    images, _ = gate_batch(cfg8, n=4)
+    ids_a, probs_a = engine8.predict(images)
+    engine8b = InferenceEngine.from_npz(cfg8, fp8_path)
+    engine8b.warmup()
+    ids_b, probs_b = engine8b.predict(images)
+    # bitwise: same fp8 leaves + same AOT program => identical bits
+    np.testing.assert_array_equal(ids_a, ids_b)
+    np.testing.assert_array_equal(probs_a, probs_b)
+    before = engine8.compile_count
+    for n in (3, 1, 4, 2):
+        engine8.predict(
+            np.zeros((n, cfg8.image_size, cfg8.image_size, 3), np.uint8))
+    assert engine8.compile_count == before == len(engine8.buckets)
+
+
+def test_fp8_gate_within_threshold(quant_stack, fp8_stack):
+    from vitax.serve.quant import run_quant_gate
+    cfg, engine_f, _, _, _, _ = quant_stack
+    _, engine8, _ = fp8_stack
+    images, labels = gate_batch(cfg)
+    gate = run_quant_gate(engine_f, engine8, images, labels)
+    assert abs(gate["delta_top1"]) <= GATE_MAX_TOP1_DELTA_PTS, gate
+    assert gate["weights_dtype"] == "float8_e4m3"
+    assert gate["act_quant"] == "off" and gate["fused_dequant"] is False
+
+
+# --- tier 2: dynamic activation quantization --------------------------------
+
+
+@pytest.fixture(scope="module")
+def act_stack(quant_stack):
+    """(cfg_act, engine_act) — int8 weights + int8 activations, fused off
+    (the default auto resolves off on CPU), so the int8 x int8 dots are
+    visible in the lowered MLIR."""
+    from vitax.serve import InferenceEngine
+    *_, int8_path = quant_stack
+    cfg_a = tiny_cfg(serve_quant_dtype="int8", serve_act_quant="int8")
+    engine_a = InferenceEngine.from_npz(cfg_a, int8_path)
+    engine_a.warmup()
+    return cfg_a, engine_a
+
+
+def test_act_quant_engine_flags_and_contract(quant_stack, act_stack):
+    _, engine_f, _, _, _, _ = quant_stack
+    cfg_a, engine_a = act_stack
+    assert engine_a.act_quant == "int8"
+    assert engine_a.fused_dequant is False  # auto resolves off on CPU
+    assert engine_a.buckets == engine_f.buckets
+    # zero recompiles under mixed traffic, same as the weight-only arm
+    before = engine_a.compile_count
+    for n in (3, 1, 4, 2):
+        engine_a.predict(
+            np.zeros((n, cfg_a.image_size, cfg_a.image_size, 3), np.uint8))
+    assert engine_a.compile_count == before == len(engine_a.buckets)
+
+
+def test_act_quant_int8_dots_in_lowered_program(act_stack):
+    """The acceptance pin: with act-quant on (fused off), the eligible
+    matmuls lower to int8 x int8 dot_generals — both dot operands i8 in the
+    stablehlo text for the largest bucket."""
+    import re
+    cfg_a, engine_a = act_stack
+    mlir = engine_a.lower_bucket_mlir(engine_a.buckets[-1])
+    i8_dots = [ln for ln in mlir.splitlines()
+               if "dot_general" in ln
+               and len(re.findall(r"tensor<[\dx]+xi8>", ln)) >= 2]
+    # qkv/proj/fc1/fc2 across the scanned blocks: at least one stacked
+    # i8 x i8 dot must survive lowering (scan keeps them in the loop body)
+    assert i8_dots, "no int8 x int8 dot_general in the lowered serve program"
+
+
+def test_act_quant_gate_within_threshold(quant_stack, act_stack):
+    from vitax.serve.quant import run_quant_gate
+    cfg, engine_f, _, _, _, _ = quant_stack
+    _, engine_a = act_stack
+    images, labels = gate_batch(cfg)
+    gate = run_quant_gate(engine_f, engine_a, images, labels)
+    assert abs(gate["delta_top1"]) <= GATE_MAX_TOP1_DELTA_PTS, gate
+    assert gate["act_quant"] == "int8"
+
+
+def test_fused_matches_unfused_predictions(quant_stack, act_stack):
+    """Forced fused kernel (interpret mode on CPU) vs the unfused act-quant
+    program: same int8 math, scales applied post-accumulation — probs agree
+    to 1e-2 relative (the acceptance bound) and typically far tighter."""
+    from vitax.serve import InferenceEngine
+    *_, int8_path = quant_stack
+    _, engine_a = act_stack
+    cfg_fused = tiny_cfg(serve_quant_dtype="int8", serve_act_quant="int8",
+                         fused_dequant="on")
+    engine_fused = InferenceEngine.from_npz(cfg_fused, int8_path)
+    engine_fused.warmup()
+    assert engine_fused.fused_dequant is True
+    images, _ = gate_batch(engine_a.cfg, n=4)
+    ids_u, probs_u = engine_a.predict(images)
+    ids_f, probs_f = engine_fused.predict(images)
+    np.testing.assert_allclose(probs_f, probs_u, rtol=1e-2, atol=1e-2)
+    np.testing.assert_array_equal(ids_f[:, 0], ids_u[:, 0])
+
+
+# --- tier 2: config validation ----------------------------------------------
+
+
+def test_act_quant_config_rejections():
+    # act-quant without int8 weights: nothing int8 to multiply against
+    with pytest.raises(AssertionError, match="serve_quant_dtype int8"):
+        tiny_cfg(serve_act_quant="int8")
+    with pytest.raises(AssertionError, match="serve_quant_dtype int8"):
+        tiny_cfg(serve_quant_dtype="float8_e4m3", serve_act_quant="int8")
+    # unknown values rejected outright
+    with pytest.raises(AssertionError, match="serve_act_quant"):
+        tiny_cfg(serve_quant_dtype="int8", serve_act_quant="int4")
+    with pytest.raises(AssertionError, match="fused_dequant"):
+        tiny_cfg(fused_dequant="yes")
+    # fused without quantized weights: no dequant to fuse
+    with pytest.raises(AssertionError, match="fused_dequant on requires"):
+        tiny_cfg(fused_dequant="on")
+    # dense-model only
+    with pytest.raises(AssertionError, match="dense-model only"):
+        tiny_cfg(serve_quant_dtype="int8", serve_act_quant="int8",
+                 moe_experts=2)
+    with pytest.raises(AssertionError, match="dense-model only"):
+        tiny_cfg(serve_quant_dtype="int8", fused_dequant="on",
+                 moe_experts=2)
+    # the valid tier-2 combos construct cleanly
+    tiny_cfg(serve_quant_dtype="int8", serve_act_quant="int8",
+             fused_dequant="on").validate()
+    tiny_cfg(serve_quant_dtype="float8_e4m3", fused_dequant="on").validate()

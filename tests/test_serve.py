@@ -183,7 +183,8 @@ def test_flatten_unflatten_round_trip():
         np.testing.assert_array_equal(a, b)
 
 
-@pytest.mark.parametrize("dtype", [None, "float32", "bfloat16", "int8"])
+@pytest.mark.parametrize("dtype", [None, "float32", "bfloat16", "int8",
+                                   "float8_e4m3"])
 def test_save_npz_dtype_round_trip(tmp_path, dtype):
     import ml_dtypes
     from vitax.checkpoint.consolidate import load_npz, save_npz
@@ -198,12 +199,15 @@ def test_save_npz_dtype_round_trip(tmp_path, dtype):
         assert back["a/kernel"].dtype == ml_dtypes.bfloat16
         np.testing.assert_allclose(
             back["a/kernel"].astype(np.float32), flat["a/kernel"], rtol=1e-2)
-    elif dtype == "int8":
+    elif dtype in ("int8", "float8_e4m3"):
         # generic load dequantizes back to f32 within half a quant step
+        # (fp8 has ~2 mantissa bits -> coarser bound than the int8 grid)
         assert back["a/kernel"].dtype == np.float32
-        atol = float(np.abs(flat["a/kernel"]).max()) / 127.0
+        qmax = 127.0 if dtype == "int8" else 240.0
+        atol = float(np.abs(flat["a/kernel"]).max()) / qmax
+        rtol = 0.0 if dtype == "int8" else 0.08
         np.testing.assert_allclose(back["a/kernel"], flat["a/kernel"],
-                                   atol=atol)
+                                   atol=atol, rtol=rtol)
         # the bias is not a matmul weight: untouched
         np.testing.assert_array_equal(back["a/b"], flat["a/b"])
     else:
@@ -211,6 +215,44 @@ def test_save_npz_dtype_round_trip(tmp_path, dtype):
         np.testing.assert_array_equal(back["a/kernel"], flat["a/kernel"])
     # non-float leaves are never cast
     assert back["step"].dtype == np.int32 and int(back["step"]) == 7
+
+
+def test_save_npz_fp8_raw_view_pin(tmp_path):
+    """fp8 leaves store as a uint8 bit-view + manifest entry and load back
+    EXACTLY (bit-for-bit) through load_npz_raw — the serve load path.
+
+    The npz container has no fp8 dtype, so the export convention is the
+    same bit-view trick the bf16 path uses with uint16: a wrong view dtype
+    or a dropped manifest entry would silently reinterpret the bytes."""
+    import ml_dtypes
+    from vitax.checkpoint.consolidate import load_npz_raw, save_npz
+    rng = np.random.default_rng(0)
+    flat = {"blocks/fc1/kernel": rng.standard_normal((8, 16)).astype(
+                np.float32),
+            "blocks/fc1/bias": np.ones(16, np.float32)}
+    out = str(tmp_path / "fp8.npz")
+    save_npz(out, flat, dtype="float8_e4m3")
+    raw, scales, manifest = load_npz_raw(out)
+    assert manifest == {"blocks/fc1/kernel": "float8_e4m3"}
+    assert raw["blocks/fc1/kernel"].dtype == ml_dtypes.float8_e4m3
+    assert set(scales) == {"blocks/fc1/kernel"}
+    assert scales["blocks/fc1/kernel"].dtype == np.float32
+    # the stored payload IS the uint8 view of the fp8 leaf: re-deriving the
+    # quantization host-side reproduces it bit-for-bit
+    s = scales["blocks/fc1/kernel"]
+    want = (flat["blocks/fc1/kernel"] / s).astype(ml_dtypes.float8_e4m3)
+    np.testing.assert_array_equal(
+        raw["blocks/fc1/kernel"].view(np.uint8), want.view(np.uint8))
+    # bias rides along untouched
+    np.testing.assert_array_equal(raw["blocks/fc1/bias"],
+                                  flat["blocks/fc1/bias"])
+    # determinism: a second export of the same tree is byte-identical
+    out2 = str(tmp_path / "fp8_b.npz")
+    save_npz(out2, flat, dtype="float8_e4m3")
+    raw2, _, _ = load_npz_raw(out2)
+    np.testing.assert_array_equal(
+        raw["blocks/fc1/kernel"].view(np.uint8),
+        raw2["blocks/fc1/kernel"].view(np.uint8))
 
 
 # --- batcher (engine-free: a fake predict_fn pins flush semantics) ----------

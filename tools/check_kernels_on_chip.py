@@ -125,6 +125,7 @@ def main():
                 dense_masked, (1, 4096, 4, 64))
 
     ok &= check_fused_optimizer()
+    ok &= check_dequant_matmul()
     print("ON-CHIP KERNEL NUMERICS:", "OK" if ok else "FAILED")
     return 0 if ok else 1
 
@@ -183,6 +184,61 @@ def check_fused_optimizer() -> bool:
     if int(got_s[0].count) != 4:
         print(f"  fused adamw count FAIL: {int(got_s[0].count)} != 4")
         ok = False
+    return ok
+
+
+def check_dequant_matmul() -> bool:
+    """Mosaic-lowered fused dequant-matmul vs the closed-form numpy math.
+
+    Three modes per the serve paths (vitax/ops/dequant_matmul.py): int8
+    weight-only, int8 weights + int8 activations (the MXU i8xi8->i32 path),
+    and fp8 weight-only. The kernel's k-loop accumulates in i32 (act) or
+    f32 (weight-only) with the scales applied once after — the closed form
+    reproduces that exactly, so agreement is tight (1e-5 relative), not an
+    accuracy-style tolerance. Shapes cover ragged m/k/n (block padding) and
+    an aligned case."""
+    import ml_dtypes
+
+    from vitax.ops.dequant_matmul import dequant_matmul, quantize_activations
+
+    rng = np.random.default_rng(11)
+    ok = True
+    for (m, k, n) in [(64, 128, 256), (130, 257, 96)]:
+        x = rng.standard_normal((m, k)).astype(np.float32)
+        w = rng.standard_normal((k, n)).astype(np.float32) * 3.0
+        scale = (np.abs(w).max(axis=0, keepdims=True) / 127.0).astype(
+            np.float32)
+        w_i8 = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        s_fp8 = (np.abs(w).max(axis=0, keepdims=True) / 240.0).astype(
+            np.float32)
+        w_fp8 = (w / s_fp8).astype(ml_dtypes.float8_e4m3)
+
+        cases = {
+            "int8 weight-only": (
+                dequant_matmul(x, jnp.asarray(w_i8), jnp.asarray(scale),
+                               act=False, fused=True, interpret=False),
+                x @ (w_i8.astype(np.float32) * scale)),
+            "fp8 weight-only": (
+                dequant_matmul(x, jnp.asarray(w_fp8), jnp.asarray(s_fp8),
+                               act=False, fused=True, interpret=False),
+                x @ (w_fp8.astype(np.float32) * s_fp8)),
+        }
+        xq, sx = jax.device_get(quantize_activations(jnp.asarray(x)))
+        cases["int8 act-quant"] = (
+            dequant_matmul(x, jnp.asarray(w_i8), jnp.asarray(scale),
+                           act=True, fused=True, interpret=False),
+            (xq.astype(np.int32) @ w_i8.astype(np.int32)).astype(np.float32)
+            * float(sx) * scale)
+
+        for name, (got, want) in cases.items():
+            got = np.asarray(jax.device_get(got), np.float32)
+            err = float(np.max(np.abs(got - want))
+                        / max(1e-6, float(np.max(np.abs(want)))))
+            status = "ok" if err < 1e-5 else "FAIL"
+            print(f"  dequant matmul {name:18s} ({m}x{k}x{n}) rel-max-err "
+                  f"{err:.2e} {status}")
+            if err >= 1e-5:
+                ok = False
     return ok
 
 

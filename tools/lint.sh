@@ -25,7 +25,8 @@ for path in vitax/telemetry tools/metrics_report.py \
             tests/test_concurrency_lint.py \
             vitax/serve/fleet/breaker.py tests/test_chaos.py \
             vitax/serve/quant.py tests/test_quant.py \
-            vitax/ops/fused_optimizer.py tests/test_fused_optimizer.py; do
+            vitax/ops/fused_optimizer.py tests/test_fused_optimizer.py \
+            vitax/ops/dequant_matmul.py tests/test_dequant_matmul.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
@@ -46,11 +47,13 @@ fi
 
 # compiled-program invariants, fast arm subset (VTX-Rnnn; rules.FAST_ARMS —
 # one train arm exercising R001-R005, the fused-optimizer arm for R008,
-# plus the full-precision and quantized serve arms for R006/R007).
+# plus the serve arms: full-precision, int8, fp8 (R006/R007) and the
+# forced-fused act-quant arm for R009.
 # VITAX_LINT_SKIP_INVARIANTS=1 skips on boxes without the jax toolchain.
 if [ "${VITAX_LINT_SKIP_INVARIANTS:-0}" != "1" ]; then
     python tools/check_invariants.py \
-        --arms zero3_overlap fused serve serve_quant || exit 1
+        --arms zero3_overlap fused serve serve_quant serve_fp8 \
+               serve_actquant || exit 1
 fi
 
 if ! python -m flake8 --version >/dev/null 2>&1; then

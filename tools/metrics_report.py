@@ -172,6 +172,7 @@ def summarize(path: str) -> dict:
         summary["quant_gate_last"] = {
             k: last.get(k)
             for k in ("weights_dtype", "baseline_dtype",
+                      "act_quant", "fused_dequant",
                       "top1_f32", "top1_quant", "top5_f32", "top5_quant",
                       "delta_top1", "delta_top5", "n")}
     if not steps:
@@ -293,7 +294,10 @@ def print_human(summary: dict) -> None:
     qg = summary.get("quant_gate_last")
     if qg:
         print(f"  quant gate ({qg['weights_dtype']} vs "
-              f"{qg['baseline_dtype']}): top1 {qg['top1_quant']:.4f} "
+              f"{qg['baseline_dtype']}, "
+              f"act_quant {qg.get('act_quant') or 'off'}, "
+              f"fused_dequant {bool(qg.get('fused_dequant'))}): "
+              f"top1 {qg['top1_quant']:.4f} "
               f"(delta {qg['delta_top1']:+.2f} pts)  "
               f"top5 {qg['top5_quant']:.4f} "
               f"(delta {qg['delta_top5']:+.2f} pts)  (n={qg['n']})")

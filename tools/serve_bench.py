@@ -332,6 +332,11 @@ def scrape_weights(url: str, timeout: float = 2.0):
                 "param_bytes": int(scope["param_bytes"]),
                 "weights_dtype": scope.get("weights_dtype",
                                            scope.get("weights_dtypes")),
+                "act_quant": scope.get("act_quant",
+                                       scope.get("act_quants", "off")),
+                "fused_dequant": scope.get("fused_dequant",
+                                           scope.get("fused_dequants",
+                                                     False)),
             }
     return None
 
@@ -442,7 +447,9 @@ def print_human(s: dict) -> None:
     weights = s.get("weights")
     if weights:
         print(f"  weights: {weights['weights_dtype']} "
-              f"({weights['param_bytes']:,} B device-resident)")
+              f"({weights['param_bytes']:,} B device-resident)  "
+              f"act_quant {weights.get('act_quant', 'off')}  "
+              f"fused_dequant {weights.get('fused_dequant', False)}")
     srv = s.get("server")
     if srv and srv["records"]:
         print(f"  server ({srv['records']} records): "

@@ -345,12 +345,16 @@ def input_output_aliases(hlo_text: str) -> List[dict]:
 
 # --- MLIR @main argument table ----------------------------------------------
 
-_MLIR_TYPE_RE = re.compile(r"tensor<([x\d]*?)(?:x)?([a-z]+\d+|i1)>")
+# dtype tail: lowercase+digits (f32, i8, bf16), an optional uppercase suffix
+# for the fp8 family (f8E4M3, f8E5M2, f8E4M3FN), or the braceless i1
+_MLIR_TYPE_RE = re.compile(
+    r"tensor<([x\d]*?)(?:x)?([a-z]+\d+(?:[A-Z][A-Z0-9]*)?|i1)>")
 _MLIR_SHARDING_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
 _MLIR_DONOR_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
 
 _MLIR_DTYPE_BYTES = {
     "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "f8E4M3": 1, "f8E5M2": 1, "f8E4M3FN": 1,
     "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
     "i8": 1, "ui8": 1, "i1": 1,
 }
@@ -566,6 +570,55 @@ def jaxpr_oversized_eqns(jaxpr_text: str, min_elems: int) -> List[dict]:
                 numel *= int(d)
         if numel >= min_elems:
             rows.append({"op": op, "shape": dims, "numel": numel})
+    return rows
+
+
+# eqn params that embed sub-jaxprs with their OWN variable namespaces: strip
+# them before building a var -> dtype map, or an inner binder reusing an
+# outer name would mislabel operands (the scan body restarts at `a`)
+JAXPR_SUBJAXPR_MARKERS = (
+    "pallas_call", "scan", "while", "cond", "remat2",
+    "custom_vjp_call_jaxpr", "custom_vjp_call", "custom_jvp_call",
+    "pjit", "shard_map")
+
+# `a:i8[2,32,96]` — any binder (lambda header or eqn output), dtype + dims
+_JAXPR_BINDER_RE = re.compile(r"(\w+):([a-z][a-z0-9_]*)\[([\d,]*)\]")
+# `c:f32[2,32,96] = convert_element_type[new_dtype=float32 ...] a`
+_JAXPR_CONVERT_RE = re.compile(
+    r"\w+:f32\[([\d,]*)\] = convert_element_type\[[^\]]*\]\s+(\w+)")
+
+
+def jaxpr_quant_dequant_converts(jaxpr_text: str, min_elems: int,
+                                 exempt_shapes=()) -> List[dict]:
+    """Weight-sized dequantizations OUTSIDE the fused kernel: f32
+    `convert_element_type` equations whose operand is a quantized-dtype var
+    (i8 / f8_*; u8 is excluded — uint8 images legitimately convert) with
+    >= min_elems elements, after stripping every sub-jaxpr body. The
+    VTX-R009 tell-tale: a fused serve program dequantizes weight blocks only
+    inside pallas_call, so any such convert at the top level is a weight
+    tensor round-tripping through HBM in float. `exempt_shapes` (dim tuples)
+    skips the sites allowed to dequant in-graph — the patchify conv kernel,
+    which no Dense-site kernel consumes. Returns rows {src_dtype, shape,
+    numel} for the rule's finding details."""
+    text = jaxpr_text
+    for marker in JAXPR_SUBJAXPR_MARKERS:
+        text = strip_bracketed(text, marker)
+    dtypes = {}
+    for m in _JAXPR_BINDER_RE.finditer(text):
+        dtypes.setdefault(m.group(1), m.group(2))
+    exempt = {tuple(s) for s in exempt_shapes}
+    rows = []
+    for m in _JAXPR_CONVERT_RE.finditer(text):
+        dims = tuple(int(d) for d in m.group(1).split(",") if d)
+        src = dtypes.get(m.group(2), "")
+        if not src.startswith(("i8", "f8")):
+            continue
+        numel = 1
+        for d in dims:
+            numel *= d
+        if numel >= min_elems and dims not in exempt:
+            rows.append({"src_dtype": src, "shape": list(dims),
+                         "numel": numel})
     return rows
 
 

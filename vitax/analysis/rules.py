@@ -314,12 +314,26 @@ def check_serve_no_recompile(program: Program, cfg: Config) -> List[Finding]:
     return out
 
 
-@rule("VTX-R007", "quant-weights-resident-int8", "ERROR", ("serve",),
-      "a quantized serve program must hold its matmul weights AS INT8: every "
-      "manifested leaf int8 on device, the lowered program taking exactly "
-      "one i8 argument per scaled leaf, and no floating weight argument at "
-      "or above block-matrix size (a dequant hoisted out of jit materializes "
-      "the f32 copy the int8 export exists to avoid — 4x the HBM, silently)",
+# how each QUANT_DTYPES entry spells in the lowered StableHLO arg table and
+# as a device-resident numpy dtype (R007 audits both representations)
+QUANT_MLIR_DTYPES = {"int8": "i8", "float8_e4m3": "f8E4M3"}
+
+
+def _quant_np_dtype(quant_dtype: str):
+    import numpy as np
+    if quant_dtype == "int8":
+        return np.dtype(np.int8)
+    import ml_dtypes
+    return np.dtype(ml_dtypes.float8_e4m3)
+
+
+@rule("VTX-R007", "quant-weights-resident", "ERROR", ("serve",),
+      "a quantized serve program must hold its matmul weights AT THE QUANT "
+      "DTYPE: every manifested leaf int8/fp8 on device, the lowered program "
+      "taking exactly one quant-dtype argument per scaled leaf, and no "
+      "floating weight argument at or above block-matrix size (a dequant "
+      "hoisted out of jit materializes the f32 copy the quantized export "
+      "exists to avoid — 4x the HBM, silently)",
       applies_to=lambda cfg: bool(getattr(cfg, "serve_quant_dtype", "")))
 def check_quant_weights_resident(program: Program, cfg: Config) -> List[Finding]:
     r = QUANT_WEIGHTS_RESIDENT
@@ -332,29 +346,34 @@ def check_quant_weights_resident(program: Program, cfg: Config) -> List[Finding]
             r, program,
             f"--serve_quant_dtype {cfg.serve_quant_dtype} but the engine "
             f"carries no quant scales — serving full-precision weights")]
-    # (1) device residency: every scaled leaf must actually be int8 — an f32
-    # leaf paired with a scale is a dequant that happened at load time
+    want = cfg.serve_quant_dtype
+    want_np = _quant_np_dtype(want)
+    want_mlir = QUANT_MLIR_DTYPES[want]
+    # (1) device residency: every scaled leaf must actually be the quant
+    # dtype — a float leaf paired with a scale is a dequant that happened
+    # at load time
     from vitax.checkpoint.consolidate import flatten_tree
     for key, leaf in flatten_tree(eng.params).items():
-        if key in scales and np.dtype(leaf.dtype) != np.int8:
+        if key in scales and np.dtype(leaf.dtype) != want_np:
             out.append(_finding(
                 r, program,
-                f"scaled leaf {key} is resident as {leaf.dtype}, not int8 — "
-                f"dequantized outside the jitted program",
+                f"scaled leaf {key} is resident as {leaf.dtype}, not {want} "
+                f"— dequantized outside the jitted program",
                 key=key, dtype=str(leaf.dtype)))
-    # (2) the lowered program's weight operands: one i8 argument per scaled
-    # leaf, and no block-sized floating argument (pos_embed and LN leaves sit
-    # far below the threshold at every geometry)
+    # (2) the lowered program's weight operands: one quant-dtype argument
+    # per scaled leaf, and no block-sized floating argument (pos_embed and
+    # LN leaves sit far below the threshold at every geometry; uint8 images
+    # lower as ui8, which never collides with i8)
     mlir = eng.lower_bucket_mlir(eng.buckets[-1])
     args = hlo.mlir_main_args(mlir)
-    n_i8 = sum(1 for a in args if a["dtype"] == "i8")
-    if n_i8 != len(scales):
+    n_q = sum(1 for a in args if a["dtype"] == want_mlir)
+    if n_q != len(scales):
         out.append(_finding(
             r, program,
-            f"lowered program has {n_i8} i8 arguments for {len(scales)} "
-            f"scaled leaves — quantized weights are not entering the "
-            f"program as int8",
-            i8_args=n_i8, scaled_leaves=len(scales)))
+            f"lowered program has {n_q} {want_mlir} arguments for "
+            f"{len(scales)} scaled leaves — quantized weights are not "
+            f"entering the program as {want}",
+            quant_args=n_q, scaled_leaves=len(scales)))
     threshold = large_param_threshold_bytes(cfg)
     for a in args:
         if a["dtype"] in ("f32", "f64", "bf16", "f16") and a["bytes"] >= threshold:
@@ -413,6 +432,53 @@ def check_fused_optimizer(program: Program, cfg: Config) -> List[Finding]:
     return out
 
 
+def _fused_dequant_cfg(cfg: Config) -> bool:
+    """Config-side gate for VTX-R009: the resolved --fused_dequant policy
+    (lazy import, same shape as VTX-R008's gate)."""
+    from vitax.ops.dequant_matmul import fused_dequant_active
+    return (bool(getattr(cfg, "serve_quant_dtype", ""))
+            and fused_dequant_active(cfg))
+
+
+@rule("VTX-R009", "fused-dequant-lowered", "ERROR", ("serve",),
+      "with the fused dequant-matmul active the traced serve program must "
+      "actually launch the Pallas kernel AND materialize no weight-sized "
+      "float tensor sourced from a quantized dtype outside it: a top-level "
+      "i8/fp8 -> f32 convert at block size is a dequantized weight round-"
+      "tripping through HBM — the fusion silently regressing to the "
+      "convert+dot chain (the serve twin of VTX-R008)",
+      applies_to=_fused_dequant_cfg)
+def check_fused_dequant(program: Program, cfg: Config) -> List[Finding]:
+    r = FUSED_DEQUANT
+    from vitax.ops.dequant_matmul import DEQUANT_KERNEL_NAME
+    eng = program.engine
+    jaxpr = eng.trace_bucket_jaxpr(eng.buckets[-1])
+    out: List[Finding] = []
+    n_launches = jaxpr.count(DEQUANT_KERNEL_NAME)
+    if n_launches == 0:
+        out.append(_finding(
+            r, program,
+            f"traced serve program contains no {DEQUANT_KERNEL_NAME} "
+            f"pallas_call — the fused dequant-matmul did not enter the "
+            f"compiled program",
+            kernel=DEQUANT_KERNEL_NAME))
+    min_elems = large_param_threshold_bytes(cfg) // 4  # f32 elements
+    # the patchify conv kernel is the one quantized leaf no Dense site
+    # consumes: it legitimately dequantizes in-graph (XLA fuses the convert
+    # into the conv's operand read) and is exempt by its exact shape
+    p = cfg.patch_size
+    exempt = ((p, p, 3, cfg.embed_dim),)
+    for row in hlo.jaxpr_quant_dequant_converts(jaxpr, min_elems, exempt):
+        out.append(_finding(
+            r, program,
+            f"weight-sized dequant outside the fused kernel: "
+            f"{row['src_dtype']} -> f32 over {row['shape']} "
+            f"({row['numel']:,} elems) at the top level of the serve "
+            f"program",
+            eqn=row, min_elems=min_elems))
+    return out
+
+
 NO_HOST_TRANSFER = RULES[0]
 DONATION_HONORED = RULES[1]
 COLLECTIVE_DTYPE = RULES[2]
@@ -421,6 +487,7 @@ NO_REPLICATED_LARGE = RULES[4]
 SERVE_NO_RECOMPILE = RULES[5]
 QUANT_WEIGHTS_RESIDENT = RULES[6]
 FUSED_OPTIMIZER = RULES[7]
+FUSED_DEQUANT = RULES[8]
 
 
 def rules_for(program: Program) -> List[Rule]:
@@ -470,11 +537,20 @@ SERVE_ARM = "serve"
 # (vitax/serve/quant.py quantize_params_for_serve); runs R006 (the AOT
 # contract is dtype-blind) plus R007
 SERVE_QUANT_ARM = "serve_quant"
-ALL_ARMS = tuple(TRAIN_ARMS) + (SERVE_ARM, SERVE_QUANT_ARM)
+# the fp8 weight arm: same machinery with float8_e4m3 leaves — R007's
+# residency/arg checks are dtype-keyed, so the arm pins the second
+# QUANT_DTYPES slot end to end
+SERVE_FP8_ARM = "serve_fp8"
+# int8 weights + dynamic activation quant + forced fused dequant-matmul
+# (interpret-mode Pallas on CPU) — the serve twin of the "fused" train arm;
+# activates VTX-R009 and reads the traced-jaxpr artifact
+SERVE_ACTQUANT_ARM = "serve_actquant"
+SERVE_ARMS = (SERVE_ARM, SERVE_QUANT_ARM, SERVE_FP8_ARM, SERVE_ACTQUANT_ARM)
+ALL_ARMS = tuple(TRAIN_ARMS) + SERVE_ARMS
 # the lint.sh / pre-push subset: one train arm covering R001-R005 (the
-# overlap arm applies every train rule), the fused arm for R008, plus both
-# serve arms for R006/R007
-FAST_ARMS = ("zero3_overlap", "fused", SERVE_ARM, SERVE_QUANT_ARM)
+# overlap arm applies every train rule), the fused arm for R008, plus the
+# serve arms for R006/R007 (all quant dtypes) and R009 (forced fused)
+FAST_ARMS = ("zero3_overlap", "fused") + SERVE_ARMS
 
 
 def arm_config(arm: str, **overrides) -> Config:
@@ -483,6 +559,11 @@ def arm_config(arm: str, **overrides) -> Config:
         kw.update(serve_max_batch=4)
     elif arm == SERVE_QUANT_ARM:
         kw.update(serve_max_batch=4, serve_quant_dtype="int8")
+    elif arm == SERVE_FP8_ARM:
+        kw.update(serve_max_batch=4, serve_quant_dtype="float8_e4m3")
+    elif arm == SERVE_ACTQUANT_ARM:
+        kw.update(serve_max_batch=4, serve_quant_dtype="int8",
+                  serve_act_quant="int8", fused_dequant="on")
     else:
         kw.update(TRAIN_ARMS[arm])
     kw.update(overrides)
@@ -517,20 +598,25 @@ def build_serve_program(cfg: Config, arm: str = SERVE_ARM) -> Program:
     from vitax.serve.engine import InferenceEngine, _build_model
 
     mesh = build_mesh(cfg)
+    # init always uses the plain-Dense model: a QuantDense model cannot
+    # init (its act path asserts int8 weights), and the param paths are
+    # identical, so the quant-aware engine model binds the same tree
+    init_model = _build_model(cfg, mesh, quantized=False)
     model = _build_model(cfg, mesh)
     sample_b = mesh.shape["dp"] * mesh.shape["fsdp"]
     sample = jnp.zeros((sample_b, cfg.image_size, cfg.image_size, 3),
                        jnp.float32)
     params, _ = init_sharded_params(
-        lambda rng: model.init(rng, sample, True),
+        lambda rng: init_model.init(rng, sample, True),
         jax.random.key(cfg.seed), cfg, mesh)
     scales, quant_dtype = None, ""
     if getattr(cfg, "serve_quant_dtype", ""):
-        # in-memory quantization — the arm exercises the int8 serve program
-        # without a checkpoint on disk (random weights: the residency and
-        # AOT invariants do not depend on the values)
+        # in-memory quantization — the arm exercises the quantized serve
+        # program without a checkpoint on disk (random weights: the
+        # residency and AOT invariants do not depend on the values)
         from vitax.serve.quant import quantize_params_for_serve
-        params, scales = quantize_params_for_serve(params, cfg, mesh)
+        params, scales = quantize_params_for_serve(
+            params, cfg, mesh, dtype=cfg.serve_quant_dtype)
         quant_dtype = cfg.serve_quant_dtype
     engine = InferenceEngine(cfg, mesh, model, params,
                              scales=scales, quant_dtype=quant_dtype)
@@ -541,6 +627,6 @@ def build_serve_program(cfg: Config, arm: str = SERVE_ARM) -> Program:
 
 def build_program(arm: str, **overrides) -> Program:
     cfg = arm_config(arm, **overrides)
-    if arm in (SERVE_ARM, SERVE_QUANT_ARM):
+    if arm in SERVE_ARMS:
         return build_serve_program(cfg, arm=arm)
     return build_train_program(cfg, arm=arm)

@@ -34,17 +34,18 @@ from vitax.checkpoint.orbax_io import epoch_ckpt_path
 # exact dtype. The key cannot collide with a param path ("/"-joined names).
 BF16_MANIFEST_KEY = "__bfloat16_keys__"
 
-# --dtype int8 manifest: a JSON document under this key records which leaves
-# were quantized, keyed BY QUANTIZED DTYPE so fp8 on supporting TPUs is a new
-# entry in the same schema, not a rework:
+# --dtype int8/float8_e4m3 manifest: a JSON document under this key records
+# which leaves were quantized, keyed BY QUANTIZED DTYPE:
 #     {"schema": 1, "dtypes": {"int8": ["params/head/kernel", ...]}}
-# ("float8_e4m3" is the reserved next slot.) Each quantized leaf's per-output-
-# channel float32 scales live beside it at QUANT_SCALE_PREFIX + key. Neither
-# key can collide with a param path ("/"-joined names never start with "__").
+# Each quantized leaf's per-output-channel float32 scales live beside it at
+# QUANT_SCALE_PREFIX + key. Neither key can collide with a param path
+# ("/"-joined names never start with "__"). fp8 leaves are stored as uint8
+# bit-views (npz has no fp8 dtype — same trick as the bf16 uint16 views) and
+# restored by dtype from this manifest.
 QUANT_MANIFEST_KEY = "__quant__"
 QUANT_SCALE_PREFIX = "__scale__/"
 QUANT_SCHEMA_VERSION = 1
-QUANT_DTYPES = ("int8",)            # implemented; "float8_e4m3" reserved
+QUANT_DTYPES = ("int8", "float8_e4m3")
 
 # Leaves never quantized, by path name: the MoE router and every LayerNorm —
 # the same names vitax/parallel/sharding.py KEEP_F32_PARAMS keeps out of the
@@ -69,7 +70,7 @@ def _is_float(v: np.ndarray) -> bool:
 
 
 def should_quantize(key: str, v: np.ndarray) -> bool:
-    """Whether --dtype int8 quantizes this leaf: a 2-D+ floating matmul
+    """Whether a quantized --dtype quantizes this leaf: a 2-D+ floating matmul
     weight (patchify/QKV/proj/MLP/head) not under a skip name."""
     parts = key.split("/")
     return (_is_float(v) and v.ndim >= 2
@@ -89,40 +90,61 @@ def _contraction_axes(key: str, ndim: int) -> Tuple[int, ...]:
     return tuple(range(stack, ndim - 1))
 
 
-def quantize_leaf(key: str, v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Per-output-channel symmetric absmax int8 quantization.
+def quant_max(dtype: str) -> float:
+    """The largest magnitude the quantized dtype represents: 127 for int8,
+    the max FINITE fp8 value for float8_e4m3 (240 for ml_dtypes' IEEE-style
+    e4m3 — absmax maps onto it exactly, so no leaf element ever rounds to
+    inf)."""
+    if dtype == "int8":
+        return 127.0
+    import ml_dtypes
+    return float(ml_dtypes.finfo(ml_dtypes.float8_e4m3).max)
 
-    scale = absmax / 127 over the contraction axes (keepdims, so dequant is
-    the broadcast `w_int8 * scale`); w_int8 = round(w / scale) in [-127, 127].
+
+def quantize_leaf(key: str, v: np.ndarray,
+                  dtype: str = "int8") -> Tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric absmax quantization to int8 or fp8.
+
+    scale = absmax / quant_max(dtype) over the contraction axes (keepdims,
+    so dequant is the broadcast `w_q * scale`); int8 rounds to
+    [-127, 127], float8_e4m3 rounds to the nearest fp8 value (the mantissa
+    rounding IS the quantization — fp8 keeps per-element exponents, so its
+    relative error is flat across each channel instead of absolute).
     All-zero channels get scale 1.0 (they quantize and dequantize to 0)."""
+    assert dtype in QUANT_DTYPES, dtype
     w = np.asarray(v, dtype=np.float32)
     axes = _contraction_axes(key, w.ndim)
     absmax = np.max(np.abs(w), axis=axes, keepdims=True) if axes else np.abs(w)
-    scale = (absmax / 127.0).astype(np.float32)
+    scale = (absmax / quant_max(dtype)).astype(np.float32)
     scale = np.where(scale == 0.0, np.float32(1.0), scale)
-    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    if dtype == "int8":
+        q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    else:
+        import ml_dtypes
+        q = (w / scale).astype(ml_dtypes.float8_e4m3)
     return q, scale
 
 
-def quantize_flat(flat: Dict[str, np.ndarray]) -> Tuple[
+def quantize_flat(flat: Dict[str, np.ndarray], dtype: str = "int8") -> Tuple[
         Dict[str, np.ndarray], Dict[str, np.ndarray]]:
-    """Quantize every eligible leaf of a flat tree.
+    """Quantize every eligible leaf of a flat tree to `dtype`.
 
-    Returns (flat with int8 leaves substituted, {key: float32 scales}).
+    Returns (flat with quantized leaves substituted, {key: float32 scales}).
     Ineligible leaves pass through untouched."""
     out, scales = {}, {}
     for k, v in flat.items():
         if should_quantize(k, v):
-            out[k], scales[k] = quantize_leaf(k, v)
+            out[k], scales[k] = quantize_leaf(k, v, dtype)
         else:
             out[k] = v
     return out, scales
 
 
-def quant_manifest(scales_keys) -> str:
-    """The dtype-keyed JSON manifest body for a set of int8-quantized keys."""
+def quant_manifest(scales_keys, dtype: str = "int8") -> str:
+    """The dtype-keyed JSON manifest body for a set of quantized keys."""
+    assert dtype in QUANT_DTYPES, dtype
     return json.dumps({"schema": QUANT_SCHEMA_VERSION,
-                       "dtypes": {"int8": sorted(scales_keys)}})
+                       "dtypes": {dtype: sorted(scales_keys)}})
 
 
 def parse_quant_manifest(doc: str) -> Dict[str, str]:
@@ -136,8 +158,7 @@ def parse_quant_manifest(doc: str) -> Dict[str, str]:
     for dtype, keys in parsed.get("dtypes", {}).items():
         assert dtype in QUANT_DTYPES, (
             f"quantized dtype {dtype!r} not supported by this build "
-            f"(implemented: {QUANT_DTYPES}; float8_e4m3 is the reserved "
-            f"next slot)")
+            f"(implemented: {QUANT_DTYPES})")
         for k in keys:
             out[k] = dtype
     return out
@@ -179,17 +200,19 @@ def save_npz(out: str, flat: Dict[str, np.ndarray],
     arrays are stored as uint16 bit-views plus a key manifest
     (BF16_MANIFEST_KEY) that load_npz uses to restore them exactly.
 
-    dtype "int8" quantizes every eligible matmul weight (should_quantize)
-    per output channel and records the key set under QUANT_MANIFEST_KEY with
-    the float32 scales at QUANT_SCALE_PREFIX + key; ineligible float leaves
-    stay at their stored dtype, so an int8 export of a bf16 tree carries both
-    manifests in one file. Casts touch FLOATING leaves only — integer/bool
-    leaves (step counters, pre-quantized int8 weights) round-trip exactly
-    under every --dtype."""
+    dtype "int8" / "float8_e4m3" quantizes every eligible matmul weight
+    (should_quantize) per output channel and records the key set under
+    QUANT_MANIFEST_KEY with the float32 scales at QUANT_SCALE_PREFIX + key;
+    ineligible float leaves stay at their stored dtype, so a quantized
+    export of a bf16 tree carries both manifests in one file. fp8 leaves
+    have no npz dtype either — they are stored as uint8 bit-views and
+    restored by manifest dtype, the same trick as the bf16 uint16 views.
+    Casts touch FLOATING leaves only — integer/bool leaves (step counters,
+    pre-quantized int8 weights) round-trip exactly under every --dtype."""
     import ml_dtypes
     scales: Dict[str, np.ndarray] = {}
-    if dtype == "int8":
-        flat, scales = quantize_flat(flat)
+    if dtype in QUANT_DTYPES:
+        flat, scales = quantize_flat(flat, dtype)
     elif dtype:
         target = (ml_dtypes.bfloat16 if dtype == "bfloat16"
                   else np.dtype(dtype))
@@ -197,12 +220,15 @@ def save_npz(out: str, flat: Dict[str, np.ndarray],
                 for k, v in flat.items()}
     bf16_keys = sorted(k for k, v in flat.items()
                        if v.dtype == ml_dtypes.bfloat16)
-    payload = {k: (v.view(np.uint16) if k in bf16_keys else v)
+    fp8_keys = {k for k, v in flat.items()
+                if v.dtype == ml_dtypes.float8_e4m3}
+    payload = {k: (v.view(np.uint16) if k in bf16_keys
+                   else v.view(np.uint8) if k in fp8_keys else v)
                for k, v in flat.items()}
     if bf16_keys:
         payload[BF16_MANIFEST_KEY] = np.asarray(bf16_keys)
     if scales:
-        payload[QUANT_MANIFEST_KEY] = np.asarray(quant_manifest(scales))
+        payload[QUANT_MANIFEST_KEY] = np.asarray(quant_manifest(scales, dtype))
         for k, s in scales.items():
             payload[QUANT_SCALE_PREFIX + k] = s
     np.savez(out, **payload)
@@ -215,10 +241,10 @@ def load_npz_raw(path: str) -> Tuple[Dict[str, np.ndarray],
     """Read a save_npz export without dequantizing.
 
     Returns (flat, scales, manifest): `flat` holds quantized leaves at their
-    stored int8 dtype (bf16 views restored as usual), `scales` the per-key
-    float32 scale arrays, `manifest` {key: quantized dtype} — all empty dicts
-    but `flat` for an unquantized file. This is the serving load path:
-    InferenceEngine.from_npz device_puts the int8 leaves as int8."""
+    stored quantized dtype (bf16 and fp8 bit-views restored), `scales` the
+    per-key float32 scale arrays, `manifest` {key: quantized dtype} — all
+    empty dicts but `flat` for an unquantized file. This is the serving load
+    path: InferenceEngine.from_npz device_puts quantized leaves verbatim."""
     import ml_dtypes
     with np.load(path) as data:
         bf16 = (set(str(k) for k in data[BF16_MANIFEST_KEY])
@@ -231,9 +257,12 @@ def load_npz_raw(path: str) -> Tuple[Dict[str, np.ndarray],
                 continue
             if k.startswith(QUANT_SCALE_PREFIX):
                 scales[k[len(QUANT_SCALE_PREFIX):]] = data[k]
+            elif k in bf16:
+                flat[k] = data[k].view(ml_dtypes.bfloat16)
+            elif manifest.get(k) == "float8_e4m3":
+                flat[k] = data[k].view(ml_dtypes.float8_e4m3)
             else:
-                flat[k] = (data[k].view(ml_dtypes.bfloat16) if k in bf16
-                           else data[k])
+                flat[k] = data[k]
         assert set(manifest) == set(scales), (
             f"quant manifest/scale mismatch in {path}: manifest names "
             f"{sorted(set(manifest) ^ set(scales))} without scales (or "
@@ -243,9 +272,9 @@ def load_npz_raw(path: str) -> Tuple[Dict[str, np.ndarray],
 
 def load_npz(path: str) -> Dict[str, np.ndarray]:
     """Read a save_npz export back to {key: array}, restoring bf16 views and
-    dequantizing int8 leaves to float32 (key set == the saved tree's; generic
-    consumers never see scales). Serving wants the int8 leaves verbatim —
-    use load_npz_raw there."""
+    dequantizing int8/fp8 leaves to float32 (key set == the saved tree's;
+    generic consumers never see scales). Serving wants the quantized leaves
+    verbatim — use load_npz_raw there."""
     flat, scales, manifest = load_npz_raw(path)
     for k in manifest:
         flat[k] = (flat[k].astype(np.float32) * scales[k]).astype(np.float32)
@@ -287,16 +316,16 @@ def main(argv=None):
     p.add_argument("--full_state", action="store_false", dest="params_only",
                    help="include optimizer state and step, not just params")
     p.add_argument("--dtype", type=str, default=None,
-                   choices=["float32", "bfloat16", "int8"],
+                   choices=["float32", "bfloat16", "int8", "float8_e4m3"],
                    help="cast float arrays for the export (default: keep "
                         "the stored dtype). bfloat16 halves the file — the "
                         "serving engine computes in bf16 anyway "
-                        "(vitax/serve/engine.py from_npz). int8 quantizes "
-                        "every matmul weight per output channel (symmetric "
-                        "absmax, float32 scales under the __quant__ "
-                        "manifest) for ~4x smaller serve weights; LN/bias/"
-                        "router leaves stay f32 (see README 'Quantized "
-                        "serving')")
+                        "(vitax/serve/engine.py from_npz). int8/float8_e4m3 "
+                        "quantize every matmul weight per output channel "
+                        "(symmetric absmax, float32 scales under the "
+                        "__quant__ manifest) for ~4x smaller serve weights; "
+                        "LN/bias/router leaves stay f32 (see README "
+                        "'Quantized serving')")
     args = p.parse_args(argv)
     consolidate(args.ckpt_dir, args.epoch, args.out, args.params_only,
                 dtype=args.dtype)

@@ -207,11 +207,23 @@ class Config:
     #   most this long for the bucket to fill (vitax/serve/batcher.py)
     serve_topk: int = 5                 # classes returned per /predict response
     serve_quant_dtype: str = ""         # expected weight quantization of the serve export: "" (full
-    #   precision) or "int8" (per-channel weights from consolidate.py
-    #   --dtype int8, dequantized at use inside the jitted forward —
-    #   vitax/serve/quant.py). The npz manifest is authoritative; this flag
-    #   asserts it, and gates the VTX-R007 invariant arm. "float8_e4m3" is
-    #   reserved in the manifest schema but not yet a valid value here
+    #   precision), "int8" or "float8_e4m3" (per-channel weights from
+    #   consolidate.py --dtype, dequantized at use inside the jitted
+    #   forward — vitax/serve/quant.py). The npz manifest is authoritative;
+    #   this flag asserts it, and gates the VTX-R007 invariant arm
+    serve_act_quant: str = "off"        # dynamic activation quantization for the serve forward:
+    #   "off" or "int8" — per-tensor absmax activation scales computed
+    #   inside the jitted forward so eligible matmuls (QKV/proj/MLP in
+    #   blocks) run int8 x int8 with a float rescale. Requires
+    #   --serve_quant_dtype int8 (int8 weights are the other operand) and
+    #   a dense model (MoE dispatch stays float). Gated by the same
+    #   quant_gate accuracy event as weight-only int8
+    fused_dequant: str = "auto"         # Pallas fused dequant-matmul (vitax/ops/dequant_matmul.py):
+    #   fuse weight dequant (+ activation quant when enabled) into the
+    #   serve matmul so no dequantized weight block round-trips through
+    #   HBM. "auto" = on when serving quantized weights on TPU (dense
+    #   model), "on" forces it (interpret mode off-TPU), "off" keeps the
+    #   jnp dot path. Pinned by the VTX-R009 invariant
     serve_queue_max: int = 1024         # dynamic batcher queue bound: submit() on a full queue raises
     #   QueueFull, which the single-engine server answers 503 (reason
     #   "queue_full") and the fleet router maps to an admission shed (429)
@@ -456,11 +468,37 @@ class Config:
         assert self.max_batch_wait_ms >= 0, (
             f"--max_batch_wait_ms must be >= 0 (0 = flush every request "
             f"immediately), got {self.max_batch_wait_ms}")
-        assert self.serve_quant_dtype in ("", "int8"), (
-            f"--serve_quant_dtype must be '' or 'int8', got "
-            f"{self.serve_quant_dtype!r}; float8_e4m3 is reserved in the "
-            f"__quant__ manifest schema (vitax/checkpoint/consolidate.py) "
-            f"but has no serve path yet")
+        assert self.serve_quant_dtype in ("", "int8", "float8_e4m3"), (
+            f"--serve_quant_dtype must be '', 'int8' or 'float8_e4m3', got "
+            f"{self.serve_quant_dtype!r}: these are the dtypes the __quant__ "
+            f"manifest schema implements (vitax/checkpoint/consolidate.py "
+            f"QUANT_DTYPES)")
+        assert self.serve_act_quant in ("off", "int8"), (
+            f"--serve_act_quant must be 'off' or 'int8', got "
+            f"{self.serve_act_quant!r}: int8 is the only activation "
+            f"quantization implemented (per-tensor dynamic absmax)")
+        if self.serve_act_quant != "off":
+            assert self.serve_quant_dtype == "int8", (
+                f"--serve_act_quant {self.serve_act_quant} requires "
+                f"--serve_quant_dtype int8 (int8 x int8 matmuls need int8 "
+                f"weights as the other operand), got serve_quant_dtype="
+                f"{self.serve_quant_dtype!r}")
+            assert self.moe_experts == 0, (
+                f"--serve_act_quant is dense-model only (MoE expert dispatch "
+                f"keeps its float einsum path), got --moe_experts "
+                f"{self.moe_experts}")
+        assert self.fused_dequant in ("auto", "on", "off"), (
+            f"--fused_dequant must be 'auto', 'on' or 'off', got "
+            f"{self.fused_dequant!r}")
+        if self.fused_dequant == "on":
+            assert self.serve_quant_dtype, (
+                f"--fused_dequant on requires a quantized "
+                f"--serve_quant_dtype: there is no weight dequant to fuse "
+                f"into a full-precision serve matmul")
+            assert self.moe_experts == 0, (
+                f"--fused_dequant on is dense-model only (MoE expert "
+                f"matmuls keep their einsum path), got --moe_experts "
+                f"{self.moe_experts}")
         assert self.serve_topk >= 1, (
             f"--serve_topk must be >= 1, got {self.serve_topk}; values above "
             f"num_classes are clamped by the engine at load time "
@@ -729,10 +767,24 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--serve_topk", type=int, default=5,
                        help="classes returned per /predict response")
     serve.add_argument("--serve_quant_dtype", type=str, default="",
-                       choices=["", "int8"],
+                       choices=["", "int8", "float8_e4m3"],
                        help="expected weight quantization of the serve "
                             "export ('' = full precision); asserts the npz "
                             "__quant__ manifest matches at load")
+    serve.add_argument("--serve_act_quant", type=str, default="off",
+                       choices=["off", "int8"],
+                       help="dynamic activation quantization for the serve "
+                            "forward: int8 computes per-tensor absmax "
+                            "activation scales inside the jitted forward so "
+                            "eligible matmuls run int8 x int8 (requires "
+                            "--serve_quant_dtype int8, dense model)")
+    serve.add_argument("--fused_dequant", type=str, default="auto",
+                       choices=["auto", "on", "off"],
+                       help="Pallas fused dequant-matmul for quantized "
+                            "serving: auto = on-TPU dense quantized serving "
+                            "only; on forces it (interpret mode off-TPU); "
+                            "off keeps the jnp dot path (VTX-R009 pins the "
+                            "fused program)")
     serve.add_argument("--serve_queue_max", type=int, default=1024,
                        help="dynamic batcher queue bound: a submit against "
                             "a full queue raises QueueFull, answered 503 "

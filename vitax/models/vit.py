@@ -48,6 +48,61 @@ Dtype = Any
 default_init = nn.initializers.truncated_normal(stddev=0.02)
 
 
+class QuantDense(nn.Module):
+    """nn.Dense's quantized-serving twin: kernel stored quantized (int8/fp8)
+    with its per-output-channel float32 scale as the sibling `qscale` param.
+
+    The serve engine merges consolidate.py's `__scale__/` arrays into the
+    param tree under this name (vitax/serve/quant.py merge_quant_scales), so
+    under `nn.scan` the stacked (L, 1, F) scales slice per layer exactly like
+    the kernels. `quant_matmul` (vitax/ops/dequant_matmul.make_quant_matmul)
+    owns the math — fused Pallas kernel or jnp reference, weight-only or
+    int8 x int8 with dynamic activation quant; `act=False` sites (the head)
+    stay weight-only always. Never used in training: `_dense` returns the
+    byte-identical nn.Dense whenever quant_matmul is None."""
+
+    features: int
+    quant_matmul: Callable
+    act: bool = True
+    use_bias: bool = True
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        kernel = self.param("kernel", default_init,
+                            (x.shape[-1], self.features), jnp.float32)
+        qscale = self.param("qscale", nn.initializers.ones,
+                            (1, self.features), jnp.float32)
+        y = self.quant_matmul(x, kernel, qscale, act=self.act)
+        y = y.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def _dense(quant_matmul: Optional[Callable], act: bool, features: int, *,
+           use_bias: bool = True, dtype, name: str):
+    """The Dense constructor every matmul site below goes through: plain
+    nn.Dense (training and full-precision serving — construction identical
+    to the pre-quantization code, so the traced program is unchanged), or
+    QuantDense under the SAME name when a quant_matmul is installed (param
+    paths stay `<site>/kernel` etc. — no wrapper scope)."""
+    if quant_matmul is None:
+        return nn.Dense(
+            features,
+            use_bias=use_bias,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            kernel_init=default_init,
+            bias_init=nn.initializers.zeros,
+            name=name,
+        )
+    return QuantDense(features=features, quant_matmul=quant_matmul, act=act,
+                      use_bias=use_bias, dtype=dtype, name=name)
+
+
 class PatchEmbed(nn.Module):
     """Conv patchify: (B, H, W, 3) -> (B, N, D). timm PatchEmbed equivalent
     (reference run_vit_training.py:124)."""
@@ -96,19 +151,17 @@ class Attention(nn.Module):
     # "involuntary full rematerialization" at this add (MULTICHIP_r03 tail).
     # Feature axis carries "tp" under tensor parallelism (Megatron layout).
     qkv_sharding: Optional[Any] = None
+    quant_matmul: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
         b, n, d = x.shape
         head_dim = d // self.num_heads
 
-        qkv = nn.Dense(
-            3 * d,
+        qkv = _dense(
+            self.quant_matmul, True, 3 * d,
             use_bias=self.qkv_bias,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=default_init,
-            bias_init=nn.initializers.zeros,
             name="qkv",
         )(x)
         if self.qkv_sharding is not None:
@@ -140,12 +193,9 @@ class Attention(nn.Module):
             out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
 
         out = out.reshape(b, n, d)
-        out = nn.Dense(
-            d,
+        out = _dense(
+            self.quant_matmul, True, d,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=default_init,
-            bias_init=nn.initializers.zeros,
             name="proj",
         )(out)
         out = nn.Dropout(rate=self.proj_dropout)(out, deterministic=deterministic)
@@ -159,25 +209,20 @@ class Mlp(nn.Module):
     out_dim: int
     dropout: float = 0.0
     dtype: Dtype = jnp.bfloat16
+    quant_matmul: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
-        x = nn.Dense(
-            self.hidden_dim,
+        x = _dense(
+            self.quant_matmul, True, self.hidden_dim,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=default_init,
-            bias_init=nn.initializers.zeros,
             name="fc1",
         )(x)
         x = nn.gelu(x, approximate=False)
         x = nn.Dropout(rate=self.dropout)(x, deterministic=deterministic)
-        x = nn.Dense(
-            self.out_dim,
+        x = _dense(
+            self.quant_matmul, True, self.out_dim,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=default_init,
-            bias_init=nn.initializers.zeros,
             name="fc2",
         )(x)
         x = nn.Dropout(rate=self.dropout)(x, deterministic=deterministic)
@@ -205,6 +250,7 @@ class Block(nn.Module):
     moe_ep_size: int = 1
     moe_dispatch_sharding: Optional[Any] = None
     token_sharding: Optional[Any] = None
+    quant_matmul: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
@@ -234,6 +280,7 @@ class Block(nn.Module):
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             qkv_sharding=qkv_sharding,
+            quant_matmul=self.quant_matmul,
             name="attn",
         )(y, deterministic=deterministic)
         x = x + y
@@ -260,6 +307,7 @@ class Block(nn.Module):
                 out_dim=d,
                 dropout=self.mlp_dropout,
                 dtype=self.dtype,
+                quant_matmul=self.quant_matmul,
                 name="mlp",
             )(y, deterministic=deterministic)
         return x + y
@@ -322,6 +370,10 @@ class VisionTransformer(nn.Module):
     # NamedSharding for (B, N, D) activations — anchors GSPMD batch sharding
     # and shards the token axis over "sp" for sequence parallelism
     token_sharding: Optional[Any] = None
+    # serving-only: routes every Dense matmul (QKV/proj/MLP/head) through
+    # the quantized path (vitax/ops/dequant_matmul.make_quant_matmul); None
+    # keeps the exact nn.Dense program (training, full-precision serving)
+    quant_matmul: Optional[Callable] = None
 
     def block_kwargs(self) -> dict:
         """Constructor kwargs for one transformer Block — shared between the
@@ -343,6 +395,7 @@ class VisionTransformer(nn.Module):
             moe_ep_size=self.moe_ep_size,
             moe_dispatch_sharding=self.moe_dispatch_sharding,
             token_sharding=self.token_sharding,
+            quant_matmul=self.quant_matmul,
         )
 
     @nn.compact
@@ -407,12 +460,11 @@ class VisionTransformer(nn.Module):
             ts = self.token_sharding
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(ts.mesh, P(ts.spec[0], None)))
-        logits = nn.Dense(
-            self.num_classes,
-            dtype=jnp.float32,  # head + loss in float32
-            param_dtype=jnp.float32,
-            kernel_init=default_init,
-            bias_init=nn.initializers.zeros,
+        # head + loss in float32; the head site never act-quantizes (its f32
+        # logits feed softmax directly — act=False in the quantized path)
+        logits = _dense(
+            self.quant_matmul, False, self.num_classes,
+            dtype=jnp.float32,
             name="head",
         )(x)
         return logits
@@ -696,10 +748,14 @@ def make_overlap_forward(cfg: Config, model: "VisionTransformer", mesh,
 
 
 def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
-                token_sharding=None, moe_dispatch_sharding=None) -> VisionTransformer:
+                token_sharding=None, moe_dispatch_sharding=None,
+                quant_matmul: Optional[Callable] = None) -> VisionTransformer:
     """Construct the model from config (reference build_fsdp_vit_model parity,
     run_vit_training.py:165-200 — minus the wrapping, which in vitax is a sharding
-    declaration applied at jit boundaries, not a module transform)."""
+    declaration applied at jit boundaries, not a module transform).
+
+    `quant_matmul` (serving only) swaps every Dense site for QuantDense —
+    see vitax/ops/dequant_matmul.make_quant_matmul."""
     return VisionTransformer(
         image_size=cfg.image_size,
         patch_size=cfg.patch_size,
@@ -723,6 +779,7 @@ def build_model(cfg: Config, attention_impl: Optional[Callable] = None,
         moe_impl=cfg.moe_impl,
         moe_dispatch_sharding=moe_dispatch_sharding,
         token_sharding=token_sharding,
+        quant_matmul=quant_matmul,
     )
 
 

@@ -60,17 +60,41 @@ def next_bucket(n: int, buckets: Tuple[int, ...]) -> int:
         f"(--serve_max_batch); the batcher never emits this")
 
 
-def _build_model(cfg: Config, mesh: Mesh):
+def _quant_model_mode(cfg: Config) -> bool:
+    """Whether serving uses the QuantDense model (vitax/models/vit.py):
+    quantized weights with activation quant and/or the fused dequant-matmul
+    engaged. Weight-only serving with the fused kernel off keeps the PR-14
+    `dequantize_tree` path — same jit signature either way, so VTX-R007's
+    arg pins hold for both."""
+    from vitax.ops.dequant_matmul import fused_dequant_active
+    if not getattr(cfg, "serve_quant_dtype", ""):
+        return False
+    if getattr(cfg, "moe_experts", 0) > 0:
+        return False
+    return (getattr(cfg, "serve_act_quant", "off") != "off"
+            or fused_dequant_active(cfg))
+
+
+def _build_model(cfg: Config, mesh: Mesh, quantized: bool = True):
     """The same model construction the training loop performs (attention
     impl + activation-sharding anchors included), so serving runs the
-    identical forward graph eval ran."""
+    identical forward graph eval ran — except under quant-model mode
+    (quantized=True and _quant_model_mode), where every Dense site becomes
+    QuantDense consuming the quantized kernel + merged qscale directly.
+    quantized=False forces the plain model (full-precision param sources:
+    from_checkpoint, param init in the invariant arms)."""
     from vitax.models import build_model
     from vitax.ops.attention import make_attention_impl
     from vitax.train.loop import _moe_dispatch_sharding, _token_sharding
+    quant_matmul = None
+    if quantized and _quant_model_mode(cfg):
+        from vitax.ops.dequant_matmul import make_quant_matmul
+        quant_matmul = make_quant_matmul(cfg)
     return build_model(
         cfg, attention_impl=make_attention_impl(cfg, mesh),
         token_sharding=_token_sharding(cfg, mesh),
-        moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh))
+        moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh),
+        quant_matmul=quant_matmul)
 
 
 class InferenceEngine:
@@ -100,6 +124,15 @@ class InferenceEngine:
         # quant.py). Empty scales = plain full-precision engine.
         self.scales: Dict[str, jax.Array] = scales or {}
         self.quant_dtype = quant_dtype
+        # tier-2 quant accounting (reported on /metrics, aggregated by the
+        # fleet router, scraped by serve_bench): dynamic activation quant
+        # mode and whether the Pallas fused dequant-matmul is engaged
+        from vitax.ops.dequant_matmul import fused_dequant_active
+        quantized = bool(self.scales)
+        self.act_quant = (getattr(cfg, "serve_act_quant", "off")
+                          if quantized else "off")
+        self.fused_dequant = bool(quantized and fused_dequant_active(cfg))
+        self._quant_model = quantized and _quant_model_mode(cfg)
         self.topk = min(cfg.serve_topk, cfg.num_classes)
         self.buckets = bucket_sizes(cfg.serve_max_batch)
         self.compile_count = 0          # warmup compiles; pinned by tests
@@ -154,7 +187,7 @@ class InferenceEngine:
             epoch = latest_epoch(ckpt_dir)
             assert epoch is not None, f"no epoch checkpoint under {ckpt_dir}"
         mesh = build_mesh(cfg)
-        model = _build_model(cfg, mesh)
+        model = _build_model(cfg, mesh, quantized=False)
         # the abstract TrainState is the restore target (no device
         # materialization); the optimizer exists only to shape it — its
         # restored moments are dropped immediately below
@@ -229,6 +262,25 @@ class InferenceEngine:
         if not self.scales:
             return forward
 
+        if self._quant_model:
+            def predict_quant_model(params, scales, images):
+                # QuantDense mode: Dense-site kernels stay quantized all the
+                # way into the matmul (fused Pallas kernel and/or int8 x
+                # int8 dots — vitax/ops/dequant_matmul.py); their scales
+                # merge into the tree as sibling qscale leaves, and only
+                # the non-site leaves (the patchify conv) dequantize
+                # in-place. VTX-R009 pins the result on the traced jaxpr.
+                from vitax.serve.quant import (
+                    dense_site_kind, dequantize_tree, merge_quant_scales)
+                site = {k: s for k, s in scales.items()
+                        if dense_site_kind(k)}
+                rest = {k: s for k, s in scales.items()
+                        if not dense_site_kind(k)}
+                p = dequantize_tree(params, rest)
+                return forward(merge_quant_scales(p, site), images)
+
+            return predict_quant_model
+
         def predict_quant(params, scales, images):
             # dequant INSIDE the jitted program: int8 weights enter as
             # program arguments, `(w * scale).astype(f32)` fuses into each
@@ -269,6 +321,21 @@ class InferenceEngine:
         compile_count movement) — the VTX-R007 artifact."""
         lowered, _ = self._lower_bucket(bucket)
         return lowered.as_text()
+
+    def trace_bucket_jaxpr(self, bucket: int) -> str:
+        """Traced jaxpr text of one bucket's predict program — the VTX-R009
+        artifact. Interpret-mode Pallas leaves no custom-call marker in
+        StableHLO (the VTX-R008 lesson), so the fused-dequant rule reads the
+        jaxpr, where every launch keeps DEQUANT_KERNEL_NAME in its
+        pallas_call params and every convert_element_type is visible."""
+        s = self.cfg.image_size
+        images = jax.ShapeDtypeStruct((bucket, s, s, 3), jnp.uint8)
+        fn = self._predict_fn()
+        if self.scales:
+            jaxpr = jax.make_jaxpr(fn)(self.params, self.scales, images)
+        else:
+            jaxpr = jax.make_jaxpr(fn)(self.params, images)
+        return str(jaxpr)
 
     def _compile_bucket(self, bucket: int) -> jax.stages.Compiled:
         lowered, batch_sh = self._lower_bucket(bucket)

@@ -442,6 +442,13 @@ class Router:
                 int(s["param_bytes"]) for s in reporting)
             snap["fleet"]["weights_dtypes"] = sorted(
                 {str(s.get("weights_dtype", "")) for s in reporting})
+            # tier-2 quant mode sets: mixed values flag a partial rollout
+            # of act-quant / fused-dequant across the fleet
+            snap["fleet"]["act_quants"] = sorted(
+                {str(s.get("act_quant", "off")) for s in reporting})
+            snap["fleet"]["fused_dequants"] = sorted(
+                {str(bool(s.get("fused_dequant", False)))
+                 for s in reporting})
         snap["replicas"] = replicas
         with self._breaker_lock:
             breakers = list(self._breakers.items())

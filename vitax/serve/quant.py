@@ -13,11 +13,20 @@ mesh/sharding layout are untouched: int8 leaves have the same shapes as
 their f32 originals, so `param_specs` shards them identically, and the
 scales (keepdims-broadcast, O(out_channels)) ride along replicated.
 
-The schema is dtype-keyed (int8 now, float8_e4m3 reserved —
-consolidate.QUANT_DTYPES), so fp8 on supporting TPUs is a new manifest
-entry and a new dequant kernel, not a rework. VTX-R007
-(vitax/analysis/rules.py) pins the result on the lowered program: large
-matmul operands int8-sourced, no block-sized float weight argument.
+The schema is dtype-keyed (consolidate.QUANT_DTYPES: int8 and
+float8_e4m3), so both quantized dtypes share this whole module — fp8
+leaves just dequantize through the same `w_q.astype(f32) * scale` read.
+VTX-R007 (vitax/analysis/rules.py) pins the result on the lowered
+program: large matmul operands quantized-dtype-sourced, no block-sized
+float weight argument.
+
+Tier 2 (this file's additions): `merge_quant_scales` folds the flat scale
+table into the param tree as sibling `qscale` leaves so the QuantDense
+serve model (vitax/models/vit.py) can consume them through `nn.scan`'s
+per-layer slicing, and `dense_site_kind` classifies which quantized
+leaves belong to QuantDense sites vs. the in-place dequant fallback (the
+patchify conv). The fused Pallas kernel itself lives in
+vitax/ops/dequant_matmul.py.
 """
 
 from __future__ import annotations
@@ -90,23 +99,66 @@ def dequantize_tree(qparams: PyTree, scales: Dict[str, jax.Array],
     return jax.tree_util.tree_map_with_path(leaf, qparams)
 
 
+def dense_site_kind(key: str) -> str:
+    """Classify a quantized leaf's consumer in the QuantDense serve model.
+
+    "block" — the in-block Dense matmuls (qkv/proj/fc1/fc2), eligible for
+    activation quant and the fused kernel; "head" — the classifier head
+    (fused weight-only; its f32 logits feed softmax, so never act-quant);
+    "" — everything else (the patchify conv kernel, MoE w1/w2), which stays
+    on the in-place `dequantize_tree` path. The patch_embed conv is named
+    "proj" too — the blocks-scope check is what excludes it."""
+    parts = key.split("/")
+    if len(parts) < 2 or parts[-1] != "kernel":
+        return ""
+    parent = parts[-2]
+    if parent == "head":
+        return "head"
+    in_blocks = any(p == "blocks" or p.startswith("blocks_") for p in parts)
+    if in_blocks and parent in ("qkv", "proj", "fc1", "fc2"):
+        return "block"
+    return ""
+
+
+def merge_quant_scales(params: PyTree, scales: Dict[str, jax.Array]) -> PyTree:
+    """Fold flat "/"-keyed scales into the param tree as sibling `qscale`
+    leaves (".../qkv/kernel" gains ".../qkv/qscale") — the shape QuantDense
+    (vitax/models/vit.py) declares, so scan-stacked (L, 1, F) scales slice
+    per layer exactly like the stacked kernels. Called INSIDE the jitted
+    predict; the input tree is copied structurally, never mutated."""
+    from collections.abc import Mapping
+
+    def copy(t):
+        return {k: (copy(v) if isinstance(v, Mapping) else v)
+                for k, v in t.items()}
+
+    tree = copy(params)
+    for key, s in scales.items():
+        node = tree
+        for p in key.split("/")[:-1]:
+            node = node[p]
+        node["qscale"] = s
+    return tree
+
+
 def scale_shardings(scales: Dict[str, np.ndarray], mesh) -> Dict[str, NamedSharding]:
     """Scales are O(out_channels) — replicate them; the int8 weights keep
     the full param_specs layout (same shapes as their f32 originals)."""
     return {k: NamedSharding(mesh, P()) for k in scales}
 
 
-def quantize_params_for_serve(params: PyTree, cfg, mesh) -> Tuple[PyTree, Dict[str, jax.Array]]:
+def quantize_params_for_serve(params: PyTree, cfg, mesh,
+                              dtype: str = "int8") -> Tuple[PyTree, Dict[str, jax.Array]]:
     """In-memory quantization of a (possibly sharded) param tree for a serve
-    engine: host-side per-channel int8 + scales, device_put back with the
-    weights in their original shard layout and the scales replicated. The
-    invariant arms use this to build the quantized serve program without a
-    checkpoint on disk (vitax/analysis/rules.py build_serve_program)."""
+    engine: host-side per-channel int8/fp8 + scales, device_put back with
+    the weights in their original shard layout and the scales replicated.
+    The invariant arms use this to build the quantized serve program without
+    a checkpoint on disk (vitax/analysis/rules.py build_serve_program)."""
     from vitax.checkpoint.consolidate import unflatten_tree
     from vitax.parallel.sharding import param_specs, shardings_of
     flat = {k: np.asarray(jax.device_get(v))
             for k, v in flatten_tree(params).items()}
-    qflat, scales = quantize_flat(flat)
+    qflat, scales = quantize_flat(flat, dtype)
     qtree = unflatten_tree(qflat)
     # param_pspec keys off path+shape only, so the int8 tree lands in the
     # exact layout the f32 tree had
@@ -158,6 +210,8 @@ def run_quant_gate(engine_f32, engine_q, images: np.ndarray,
         "n": int(images.shape[0]),
         "weights_dtype": engine_q.weights_dtype,
         "baseline_dtype": engine_f32.weights_dtype,
+        "act_quant": getattr(engine_q, "act_quant", "off"),
+        "fused_dequant": getattr(engine_q, "fused_dequant", False),
     }
     if recorder is not None:
         recorder.event("quant_gate", **gate)

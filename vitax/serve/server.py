@@ -448,6 +448,14 @@ def _make_handler(ctx: ServeContext):
                     snap["weights_dtype"] = ctx.engine.weights_dtype
                 if hasattr(ctx.engine, "param_bytes"):
                     snap["param_bytes"] = ctx.engine.param_bytes()
+                # tier-2 quant mode flags (PR 16): which activation-quant
+                # and fused-dequant policy this replica's program compiled
+                # with — the fleet router surfaces mixed values during a
+                # rollout
+                if hasattr(ctx.engine, "act_quant"):
+                    snap["act_quant"] = ctx.engine.act_quant
+                if hasattr(ctx.engine, "fused_dequant"):
+                    snap["fused_dequant"] = ctx.engine.fused_dequant
                 self._reply(200, snap)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
