@@ -1,0 +1,405 @@
+"""Autoscaler + placement: hysteretic fleet sizing, drain-safe scale-in.
+
+Everything time-driven runs against tick(now=...) with injected clocks —
+no sleeps, no real sockets for the scaling logic itself. The pins:
+
+- scale-OUT fires only after a pressure signal (shed rate, predicted-wait
+  overshoot, brownout) sustains for `dwell_s`, and `cooldown_s` dead time
+  separates consecutive actions (blips never scale);
+- a warming replica relieves predicted-wait pressure at the admission
+  discount, so one scale-out doesn't cascade into N;
+- scale-IN retires first and discards ONLY once the victim's in-flight
+  count reaches zero (the drain-before-terminate acceptance pin) — and
+  even the drain-timeout force path still SIGTERM-drains;
+- the [min_replicas, max_replicas] clamps hold, and a fleet below the
+  floor is repaired immediately (no dwell);
+- the placement agent provisions/releases replicas over real HTTP with an
+  injected spawn, and the client round-trips the contract.
+"""
+
+import signal
+import sys
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from vitax.serve.fleet import (
+    EJECTED,
+    READY,
+    AdmissionController,
+    Autoscaler,
+    PlacementAgent,
+    PlacementClient,
+    ReplicaManager,
+    start_agent,
+    stop_agent,
+)
+
+
+class DummyRecorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **payload):
+        self.events.append((kind, payload))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+class FakeProc:
+    """Popen stand-in with a settable return code."""
+
+    def __init__(self):
+        self.rc = None
+        self.signals = []
+
+    def poll(self):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        self.rc = 0
+
+    def kill(self):
+        self.rc = -9
+
+
+def _never(url, timeout):
+    raise ConnectionError("unreachable")
+
+
+def mk_manager(n_ready=1, managed=False, **kw):
+    """A manager with n replicas forced READY (no health loop running);
+    managed=True backs each with a FakeProc so discard() drains it."""
+    procs = []
+
+    def spawn(argv):
+        p = FakeProc()
+        procs.append(p)
+        return p
+
+    m = ReplicaManager(http_get=_never, spawn=spawn, **kw)
+    for i in range(n_ready):
+        if managed:
+            r = m.manage(["serve", "cmd"], f"http://r{i}", name=f"r{i}")
+        else:
+            r = m.adopt(f"http://r{i}", name=f"r{i}")
+        r.state = READY
+    return m, procs
+
+
+def adopt_scaler(manager):
+    """A scale_out fn that grows the fleet like the CLI closure does
+    (adopt -> STARTING until health admits it), counting calls."""
+    calls = []
+
+    def scale_out():
+        r = manager.adopt(f"http://new{len(calls)}")
+        calls.append(r)
+        return r
+
+    return scale_out, calls
+
+
+# --- scale-out signals ---------------------------------------------------------
+
+
+def test_scale_out_on_sustained_shed_rate_with_dwell_and_cooldown():
+    m, _ = mk_manager(n_ready=1)
+    adm = AdmissionController(deadline_ms=0.0)  # sheds counted, check off
+    scale_out, calls = adopt_scaler(m)
+    a = Autoscaler(m, adm, min_replicas=1, max_replicas=3,
+                   scale_out=scale_out, dwell_s=2.0, cooldown_s=5.0,
+                   shed_rate_per_s=1.0)
+
+    def shed(n):
+        for _ in range(n):
+            adm.record_shed(reason="test")
+
+    assert a.tick(now=0.0) is None            # baseline sample
+    shed(5)
+    assert a.tick(now=1.0) is None            # rate 5/s: pressure starts
+    shed(5)
+    assert a.tick(now=2.0) is None            # sustained 1s < dwell 2s
+    shed(5)
+    assert a.tick(now=3.0) == "scale_out"     # dwell met
+    assert len(calls) == 1 and a.scale_out_total == 1
+    assert m.active_count() == 2
+    # cooldown: pressure keeps firing but no action until now >= 8
+    shed(5)
+    assert a.tick(now=4.0) is None
+    shed(10)
+    assert a.tick(now=6.0) is None            # dwell met again, in cooldown
+    shed(10)
+    assert a.tick(now=8.0) == "scale_out"
+    assert len(calls) == 2 and m.active_count() == 3
+    # a blip never scales: rate collapses to zero, streak resets
+    assert a.tick(now=9.0) is None
+    assert a._pressure_since is None
+    # at max_replicas the clamp holds no matter the pressure
+    shed(20)
+    a.tick(now=14.0)
+    shed(20)
+    assert a.tick(now=16.0) is None
+    assert len(calls) == 2 and m.active_count() == 3
+
+
+def test_scale_out_on_predicted_wait_and_warming_relief():
+    rec = DummyRecorder()
+    m, _ = mk_manager(n_ready=1)
+    adm = AdmissionController(deadline_ms=800.0)
+    adm.observe(1.0)                          # EWMA service time 1s
+    m.find("r0").in_flight = 1                # predicted 1.0s > 0.8s
+    scale_out, calls = adopt_scaler(m)
+    a = Autoscaler(m, adm, min_replicas=1, max_replicas=3,
+                   scale_out=scale_out, dwell_s=2.0, cooldown_s=0.0,
+                   recorder=rec)
+    assert a.tick(now=0.0) is None
+    assert a.tick(now=2.0) == "scale_out"
+    assert len(calls) == 1
+    assert rec.events[-1][1]["reason"] == "predicted_wait"
+    # the new replica is warming (STARTING): admission counts it at the
+    # 0.5 discount, predicted drops to 1/1.5 = 0.67s <= 0.8s -> pressure
+    # gone, so one scale-out does not cascade into a second
+    assert m.warming_count() == 1
+    for now in (3.0, 5.0, 8.0):
+        assert a.tick(now=now) is None
+    assert len(calls) == 1
+
+
+def test_scale_out_on_brownout_dwell():
+    rec = DummyRecorder()
+    m, _ = mk_manager(n_ready=1)
+    degraded = m.find("r0")
+    with m._lock:
+        degraded.last_health = {"degraded": True}
+    scale_out, calls = adopt_scaler(m)
+    a = Autoscaler(m, min_replicas=1, max_replicas=2, scale_out=scale_out,
+                   dwell_s=1.0, cooldown_s=0.0, recorder=rec)
+    assert a.tick(now=0.0) is None            # brownout seen, not sustained
+    assert a.tick(now=0.5) is None
+    assert a.tick(now=1.0) == "scale_out"
+    assert len(calls) == 1
+    assert rec.events[-1][1]["reason"] == "brownout"
+
+
+def test_floor_repair_is_immediate():
+    """A fleet below min_replicas (restart budget exhausted) grows back on
+    the next tick — no dwell, regardless of traffic."""
+    rec = DummyRecorder()
+    m, _ = mk_manager(n_ready=1)
+    scale_out, calls = adopt_scaler(m)
+    a = Autoscaler(m, min_replicas=2, max_replicas=3, scale_out=scale_out,
+                   dwell_s=60.0, cooldown_s=0.0, recorder=rec)
+    assert a.tick(now=0.0) == "scale_out"     # first tick, no streak needed
+    assert len(calls) == 1 and m.active_count() == 2
+    assert rec.events[-1][1]["reason"] == "below_min"
+    assert a.tick(now=1.0) is None            # floor met, nothing more
+
+
+def test_scale_out_failure_contained_and_cooled_down():
+    """A failed provision must not kill the loop — it records the failure,
+    takes the cooldown, and tries again after it."""
+    rec = DummyRecorder()
+    m, _ = mk_manager(n_ready=1)
+    degraded = m.find("r0")
+    with m._lock:
+        degraded.last_health = {"degraded": True}
+    attempts = []
+
+    def scale_out():
+        attempts.append(1)
+        raise RuntimeError("placement agent unreachable")
+
+    a = Autoscaler(m, min_replicas=1, max_replicas=2, scale_out=scale_out,
+                   dwell_s=2.0, cooldown_s=5.0, recorder=rec)
+    a.tick(now=0.0)
+    assert a.tick(now=2.0) is None            # attempt #1 failed
+    assert len(attempts) == 1 and a.scale_out_total == 0
+    assert ("autoscale", rec.events[-1][1])[1]["event"] == "scale_out_failed"
+    a.tick(now=3.0)                           # streak restarts
+    assert a.tick(now=5.0) is None            # dwell met, still cooling down
+    assert len(attempts) == 1
+    assert a.tick(now=7.0) is None            # cooldown ends at 7.0, retried
+    assert len(attempts) == 2
+
+
+# --- scale-in: drain before terminate ------------------------------------------
+
+
+def test_scale_in_drains_before_terminate():
+    """The acceptance pin: the victim is retired (out of rotation), and the
+    process sees NO signal until its in-flight count reaches zero — only
+    then is it SIGTERM-drained and removed."""
+    rec = DummyRecorder()
+    m, procs = mk_manager(n_ready=2, managed=True)
+    released = []
+    a = Autoscaler(m, min_replicas=1, max_replicas=2,
+                   release=released.append, dwell_s=2.0, cooldown_s=0.0,
+                   idle_occupancy=0.25, drain_timeout_s=100.0, recorder=rec)
+    a.tick(now=0.0)                           # idle streak opens
+    assert a.tick(now=2.0) == "retire"
+    victim = m.find("r0")                     # least loaded (tie -> first)
+    assert victim.retired and victim.state == EJECTED
+    assert m.ready_count() == 1 and m.active_count() == 1
+    # a request is still draining on the victim: no signal, no discard
+    victim.in_flight = 1
+    assert a.tick(now=3.0) is None
+    assert a.tick(now=4.0) is None
+    assert procs[0].signals == []             # untouched while in flight
+    assert victim in m.replicas
+    # drain completes -> SIGTERM-drain + removal, release() for remotes
+    victim.in_flight = 0
+    assert a.tick(now=5.0) == "scale_in"
+    assert procs[0].signals == [signal.SIGTERM]
+    assert victim not in m.replicas
+    assert victim.exit_code == 0              # the drain contract
+    assert a.scale_in_total == 1
+    assert released == [victim]
+    assert rec.events[-1][1] == {"event": "scale_in", "replica": "r0",
+                                 "forced": False, "size": 1}
+    # the survivor keeps the fleet at the floor: no further retire
+    a.tick(now=7.0)
+    assert a.tick(now=9.0) is None
+    assert m.active_count() == 1
+
+
+def test_scale_in_forced_after_drain_timeout_still_drains():
+    m, procs = mk_manager(n_ready=2, managed=True)
+    a = Autoscaler(m, min_replicas=1, max_replicas=2, dwell_s=1.0,
+                   cooldown_s=0.0, drain_timeout_s=10.0)
+    a.tick(now=0.0)
+    assert a.tick(now=1.0) == "retire"        # drain deadline = 11.0
+    victim = m.find("r0")
+    victim.in_flight = 1                      # never drains
+    assert a.tick(now=5.0) is None
+    assert procs[0].signals == []
+    assert a.tick(now=11.0) == "scale_in"     # forced at the deadline
+    assert a.last_event["forced"] is True
+    # even forced, the exit is a SIGTERM drain, not a kill
+    assert procs[0].signals == [signal.SIGTERM]
+    assert victim not in m.replicas
+
+
+def test_idle_blip_never_scales_in():
+    m, _ = mk_manager(n_ready=2)
+    a = Autoscaler(m, min_replicas=1, max_replicas=2, dwell_s=2.0,
+                   cooldown_s=0.0, idle_occupancy=0.25)
+    a.tick(now=0.0)                           # idle streak opens
+    m.find("r0").in_flight = 2                # load arrives mid-streak
+    assert a.tick(now=1.9) is None            # occupancy 1.0: streak reset
+    m.find("r0").in_flight = 0
+    assert a.tick(now=2.0) is None            # streak reopens at 2.0
+    assert a.tick(now=3.9) is None            # 1.9s < dwell
+    assert a.tick(now=4.0) == "retire"
+
+
+def test_snapshot_shape():
+    m, _ = mk_manager(n_ready=1)
+    a = Autoscaler(m, min_replicas=1, max_replicas=4)
+    snap = a.snapshot()
+    assert snap == {"min_replicas": 1, "max_replicas": 4,
+                    "scale_out_total": 0, "scale_in_total": 0,
+                    "shed_rate_per_s": 0.0, "draining": None,
+                    "last_event": None}
+
+
+def test_loop_start_stop_clean():
+    m, _ = mk_manager(n_ready=1)
+    a = Autoscaler(m, min_replicas=1, max_replicas=1, interval_s=0.02)
+    a.start()
+    time.sleep(0.1)                           # a few real ticks, no action
+    a.stop()
+    assert a._thread is None
+    assert a.scale_out_total == 0 and a.scale_in_total == 0
+
+
+# --- placement agent + client ---------------------------------------------------
+
+
+def test_agent_provision_release_http_roundtrip():
+    """Real HTTP against a real agent, injected spawn: provision boots a
+    `python -m vitax.serve` argv on the agent-assigned port, release
+    SIGTERM-drains it, and the error contract (400 duplicate / 404
+    unknown) round-trips through the client."""
+    spawned, procs = [], []
+
+    def spawn(argv):
+        spawned.append(argv)
+        p = FakeProc()
+        procs.append(p)
+        return p
+
+    mgr = ReplicaManager(spawn=spawn, http_get=_never,
+                         health_interval_s=0.05)
+    agent = PlacementAgent(advertise_host="127.0.0.1", base_port=9200,
+                           manager=mgr)
+    httpd = start_agent(agent, port=0)
+    client = PlacementClient(
+        f"http://127.0.0.1:{httpd.server_address[1]}", timeout_s=10.0)
+    try:
+        health = client.healthz()
+        assert health["status"] == "ok" and health["replicas"] == 0
+        out = client.provision(["--ckpt_dir", "/tmp/x"], name="r1")
+        assert out == {"name": "r1", "url": "http://127.0.0.1:9200",
+                       "port": 9200}
+        assert spawned[0] == [sys.executable, "-m", "vitax.serve",
+                              "--ckpt_dir", "/tmp/x",
+                              "--serve_port", "9200"]
+        out2 = client.provision(["--ckpt_dir", "/tmp/x"])  # agent names it
+        assert out2["name"] == "agent_replica_1" and out2["port"] == 9201
+        snap = client.replicas()
+        assert snap["provisions_total"] == 2
+        assert set(snap["replicas"]) == {"r1", "agent_replica_1"}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            client.provision(["--ckpt_dir", "/tmp/x"], name="r1")
+        assert e.value.code == 400            # duplicate name refused
+        assert client.release("r1") == {"released": "r1"}
+        assert procs[0].signals == [signal.SIGTERM]  # drained, not killed
+        assert mgr.find("r1") is None
+        with pytest.raises(urllib.error.HTTPError) as e:
+            client.release("r1")              # now unknown
+        assert e.value.code == 404
+    finally:
+        stop_agent(httpd, agent)
+    assert procs[1].signals == [signal.SIGTERM]  # stop drains the rest
+
+
+def test_placement_client_injected_transport():
+    calls = []
+
+    def http_json(url, payload, timeout):
+        calls.append((url, payload, timeout))
+        return {"ok": True}
+
+    c = PlacementClient("http://agent:7070/", timeout_s=3.0,
+                        http_json=http_json)
+    assert c.healthz() == {"ok": True}
+    assert calls[-1] == ("http://agent:7070/healthz", None, 3.0)
+    c.replicas()
+    assert calls[-1] == ("http://agent:7070/replicas", None, 3.0)
+    c.provision(["--x"], name="n", port=5)
+    assert calls[-1] == ("http://agent:7070/provision",
+                         {"argv": ["--x"], "name": "n", "port": 5}, 3.0)
+    c.release("n")
+    assert calls[-1] == ("http://agent:7070/release", {"name": "n"}, 3.0)
+
+
+def test_agent_rejects_bad_provision_payloads():
+    agent = PlacementAgent(manager=ReplicaManager(
+        spawn=lambda argv: FakeProc(), http_get=_never))
+    with pytest.raises(ValueError, match="list of strings"):
+        agent.provision("--not-a-list")
+    with pytest.raises(ValueError, match="list of strings"):
+        agent.provision([1, 2, 3])
+
+
+def test_autoscaler_bounds_validated():
+    m, _ = mk_manager(n_ready=1)
+    with pytest.raises(AssertionError):
+        Autoscaler(m, min_replicas=3, max_replicas=2)
+    with pytest.raises(AssertionError):
+        Autoscaler(m, min_replicas=0, max_replicas=2)

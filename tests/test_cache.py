@@ -1,0 +1,298 @@
+"""PredictionCache: content-addressed router cache, exact by construction.
+
+Classification over an AOT-pinned engine is deterministic, so the cache
+contract is EXACT replay, not approximation — and that is what these tests
+pin: a hit is bitwise-identical to the first miss's 200 body, distinct
+topk values never alias (same image at topk 1 and topk 5 are different
+keys), TTL and LRU bounds hold under an injected clock (no real time),
+and — through a real Router front door — a repeated body is answered from
+the cache without the replica seeing a second predict (the predict-count
+pin), even when the fleet has zero ready replicas.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from vitax.serve.fleet import (
+    PredictionCache,
+    ReplicaManager,
+    Router,
+    start_router,
+    stop_router,
+)
+
+PNG_A = b"\x89PNG-fake-image-bytes-a"
+PNG_B = b"\x89PNG-fake-image-bytes-b"
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class DummyRecorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **payload):
+        self.events.append((kind, payload))
+
+
+# --- key semantics -------------------------------------------------------------
+
+
+def test_key_separates_bytes_and_topk():
+    """The content address is (sha256(bytes), topk): either component
+    changing changes the key, and equal inputs collide on purpose."""
+    assert PredictionCache.key(PNG_A, 3) == PredictionCache.key(PNG_A, 3)
+    assert PredictionCache.key(PNG_A, 1) != PredictionCache.key(PNG_A, 5)
+    assert PredictionCache.key(PNG_A, 3) != PredictionCache.key(PNG_B, 3)
+    assert (PredictionCache.key(PNG_A, "default")
+            != PredictionCache.key(PNG_A, 1))
+
+
+def test_distinct_topk_never_alias():
+    c = PredictionCache(max_entries=8)
+    c.put(PNG_A, 1, b'{"classes": [1]}')
+    c.put(PNG_A, 5, b'{"classes": [1, 0, 2, 3, 4]}')
+    assert c.get(PNG_A, 1) == b'{"classes": [1]}'
+    assert c.get(PNG_A, 5) == b'{"classes": [1, 0, 2, 3, 4]}'
+    assert c.get(PNG_A, 3) is None  # never served a topk it never stored
+
+
+def test_hit_is_bitwise_exact():
+    """A hit replays the stored 200 payload verbatim — byte-for-byte, not
+    a re-serialization (key ordering, float formatting all preserved)."""
+    payload = json.dumps({"classes": [2, 0, 1],
+                          "probs": [0.5000001, 0.3, 0.1999999],
+                          "latency_ms": 12.345}).encode("utf-8")
+    c = PredictionCache(max_entries=4)
+    c.put(PNG_A, "default", payload)
+    got = c.get(PNG_A, "default")
+    assert got == payload
+    assert isinstance(got, bytes)
+
+
+# --- TTL / LRU under an injected clock -----------------------------------------
+
+
+def test_ttl_expiry_with_injected_clock():
+    clock = FakeClock(t=100.0)
+    c = PredictionCache(max_entries=4, ttl_s=10.0, clock=clock)
+    c.put(PNG_A, 3, b"fresh")
+    clock.t = 109.9
+    assert c.get(PNG_A, 3) == b"fresh"   # inside the TTL
+    clock.t = 110.0
+    assert c.get(PNG_A, 3) is None       # at the boundary: expired
+    assert c.size() == 0                 # expiry drops the entry
+    assert c.expirations_total == 1
+    # a re-put restarts the clock
+    c.put(PNG_A, 3, b"refilled")
+    clock.t = 115.0
+    assert c.get(PNG_A, 3) == b"refilled"
+
+
+def test_ttl_zero_means_never_expires():
+    clock = FakeClock(t=0.0)
+    c = PredictionCache(max_entries=4, ttl_s=0.0, clock=clock)
+    c.put(PNG_A, 3, b"eternal")
+    clock.t = 1e9
+    assert c.get(PNG_A, 3) == b"eternal"
+    assert c.expirations_total == 0
+
+
+def test_lru_eviction_bounded_and_recency_refreshed():
+    c = PredictionCache(max_entries=2, ttl_s=0.0)
+    c.put(b"a", 3, b"A")
+    c.put(b"b", 3, b"B")
+    assert c.get(b"a", 3) == b"A"   # refreshes a's recency -> b is LRU
+    c.put(b"c", 3, b"C")            # past the bound: evicts b
+    assert c.size() == 2
+    assert c.get(b"b", 3) is None
+    assert c.get(b"a", 3) == b"A"
+    assert c.get(b"c", 3) == b"C"
+    assert c.evictions_total == 1
+
+
+def test_disabled_at_zero_entries():
+    c = PredictionCache(max_entries=0)
+    assert c.enabled is False
+    c.put(PNG_A, 3, b"never stored")
+    assert c.get(PNG_A, 3) is None
+    assert c.size() == 0
+    assert c.hits_total == 0 and c.misses_total == 0  # off = not even counted
+
+
+# --- telemetry + snapshot ------------------------------------------------------
+
+
+def test_hit_event_carries_running_totals():
+    """tools/metrics_report.py derives the hit rate from the JSONL alone,
+    so every hit event must carry the running totals (misses emit no
+    per-event record by design)."""
+    rec = DummyRecorder()
+    c = PredictionCache(max_entries=4, recorder=rec)
+    assert c.get(PNG_A, 3) is None        # miss: counted, no event
+    assert rec.events == []
+    c.put(PNG_A, 3, b"X")
+    assert c.get(PNG_A, 3) == b"X"
+    kind, payload = rec.events[-1]
+    assert kind == "cache" and payload["decision"] == "hit"
+    assert payload["hits_total"] == 1 and payload["misses_total"] == 1
+    snap = c.snapshot()
+    assert snap["hits_total"] == 1 and snap["misses_total"] == 1
+    assert snap["hit_rate"] == 0.5
+    assert snap["size"] == 1 and snap["enabled"] is True
+
+
+# --- through the router: hits bypass dispatch (predict-count pin) --------------
+
+
+class CountingReplica:
+    """Minimal serve stand-in: /healthz ready, every POST is a predict that
+    bumps predict_count and answers the single-engine 200 contract."""
+
+    def __init__(self):
+        self.ready = True
+        self.predict_count = 0
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                self._reply(200, {"status": "ok", "ready": fake.ready})
+
+            def do_POST(self):  # noqa: N802
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                with fake._lock:
+                    fake.predict_count += 1
+                self._reply(200, {"classes": [1, 0, 2],
+                                  "probs": [0.5, 0.3, 0.2],
+                                  "latency_ms": 1.0})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def cached_fleet():
+    fake = CountingReplica()
+    manager = ReplicaManager()
+    manager.adopt(fake.url, name="a")
+    manager.poll_once()
+    cache = PredictionCache(max_entries=64)
+    router = Router(manager, cache=cache, request_timeout_s=10.0)
+    httpd = start_router(router, 0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield manager, router, cache, url, fake
+    stop_router(httpd, router)
+    fake.stop()
+
+
+def _post_raw(url, body, content_type="image/png", timeout=30.0):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": content_type})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def test_router_repeated_bytes_pin_predict_count(cached_fleet):
+    """The acceptance pin: the second identical request never reaches the
+    replica — predict_count stays at 1, the response bytes are identical,
+    and the hit is flagged (header + counters)."""
+    manager, router, cache, url, fake = cached_fleet
+    s1, h1, body1 = _post_raw(url + "/predict", PNG_A)
+    assert s1 == 200 and fake.predict_count == 1
+    assert "X-Vitax-Cache" not in h1
+    s2, h2, body2 = _post_raw(url + "/predict", PNG_A)
+    assert s2 == 200
+    assert body2 == body1                     # bitwise replay
+    assert fake.predict_count == 1            # zero extra engine predicts
+    assert h2.get("X-Vitax-Cache") == "hit"
+    assert router.metrics.cache_hits_total == 1
+    # distinct bytes miss and dispatch normally
+    s3, _, _ = _post_raw(url + "/predict", PNG_B)
+    assert s3 == 200 and fake.predict_count == 2
+    snap = router.fleet_metrics()
+    assert snap["cache_hits"] == 1
+    assert snap["cache"]["misses_total"] == 2
+    assert snap["cache_hit_rate"] == round(1 / 3, 4)
+    # cache hits are not replica work: requests_total counts dispatches only
+    assert snap["requests_total"] == 2
+
+
+def test_router_cache_hits_survive_zero_ready_replicas(cached_fleet):
+    """Hits bypass readiness and admission entirely: cached answers keep
+    flowing while the whole fleet is down; novel bytes get the 503."""
+    manager, router, cache, url, fake = cached_fleet
+    _post_raw(url + "/predict", PNG_A)        # seed the cache
+    fake.ready = False
+    manager.poll_once()                       # ejects the only replica
+    assert manager.ready_count() == 0
+    status, headers, body = _post_raw(url + "/predict", PNG_A)
+    assert status == 200 and headers.get("X-Vitax-Cache") == "hit"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_raw(url + "/predict", PNG_B)    # novel bytes: no replica
+    assert e.value.code == 503
+    assert json.load(e.value)["reason"] == "no_ready_replicas"
+    assert fake.predict_count == 1
+
+
+def test_router_never_caches_degraded_answers(cached_fleet):
+    """A browned-out fleet clamps topk to 1 — replaying those answers
+    after recovery would be wrong, so degraded responses are never
+    stored (and re-dispatch once the brownout lifts)."""
+    manager, router, cache, url, fake = cached_fleet
+    replica = manager.find("a")
+    with manager._lock:
+        replica.last_health = {"status": "ok", "ready": True,
+                               "degraded": True}
+    s1, _, _ = _post_raw(url + "/predict", PNG_A)
+    assert s1 == 200 and cache.size() == 0    # answered, not stored
+    with manager._lock:
+        replica.last_health = {"status": "ok", "ready": True,
+                               "degraded": False}
+    s2, h2, _ = _post_raw(url + "/predict", PNG_A)
+    assert s2 == 200 and "X-Vitax-Cache" not in h2
+    assert fake.predict_count == 2            # the miss re-dispatched
+    assert cache.size() == 1                  # healthy answer cached now
+    _, h3, _ = _post_raw(url + "/predict", PNG_A)
+    assert h3.get("X-Vitax-Cache") == "hit"
+
+
+def test_request_topk_keying():
+    """JSON bodies may carry a per-request topk: it becomes the key's topk
+    component; raw images and malformed JSON key as the replica default."""
+    assert Router._request_topk(b'{"topk": 5}', "application/json") == 5
+    assert Router._request_topk(b'{"topk": "2"}', "application/json") == 2
+    assert Router._request_topk(b'{"image": "..."}',
+                                "application/json") == "default"
+    assert Router._request_topk(b"not json{", "application/json") == "default"
+    assert Router._request_topk(PNG_A, "image/png") == "default"
+    assert Router._request_topk(PNG_A, "") == "default"

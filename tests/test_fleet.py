@@ -12,6 +12,7 @@ client-visible errors, re-admission after the supervised restart, and
 clean SIGTERM drains (exit 0).
 """
 
+import base64
 import io
 import json
 import os
@@ -26,6 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 import pytest
 
+from vitax import faults
 from vitax.config import Config
 from vitax.serve.fleet import (
     DEAD,
@@ -33,6 +35,8 @@ from vitax.serve.fleet import (
     READY,
     STARTING,
     AdmissionController,
+    Autoscaler,
+    PredictionCache,
     ReplicaManager,
     Router,
     start_router,
@@ -109,6 +113,7 @@ class FakeReplica:
         self.fail_predicts = False  # /predict answers 500
         self.bad_request = False    # /predict answers 400 (client's fault)
         self.queue_full = False     # /predict answers 503 reason queue_full
+        self.batch_unsupported = False  # /predict_batch answers 404 (old binary)
         self.latency_s = 0.0
         self.hold = None            # Event: /predict blocks until set
         self.predict_started = threading.Event()
@@ -147,6 +152,9 @@ class FakeReplica:
 
             def do_POST(self):  # noqa: N802
                 self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if self.path == "/predict_batch" and fake.batch_unsupported:
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+                    return
                 if fake.queue_full:
                     self._reply(503, {"error": "overloaded",
                                       "reason": "queue_full"},
@@ -267,6 +275,34 @@ def test_admission_ewma_and_record_shed():
     snap = a.snapshot()
     assert snap["shed_total"] == a.shed_total
     assert snap["deadline_ms"] == 100.0
+
+
+def test_admission_warming_capacity_discount():
+    """Mid-scale-out the shed rate drops: a live-but-warming replica counts
+    at --warming_capacity_frac (it will be serving within one warmup), so
+    the prediction relaxes toward the NEW capacity instead of shedding at
+    the old estimate until the first replica flips ready."""
+    a = AdmissionController(deadline_ms=800.0)
+    a.observe(1.0)  # EWMA service time 1s
+    # 1 ready, no scale-out in progress: predicted 1.0s > 0.8s -> shed
+    assert a.check(depth=1, ready_replicas=1) is not None
+    # same load mid-scale-out: the warming replica counts at 0.5, so
+    # predicted = 1 * 1.0 / 1.5 = 0.67s <= 0.8s -> admitted again
+    assert a.check(depth=1, ready_replicas=1, warming_replicas=1) is None
+    assert a.shed_total == 1  # the warming credit IS the shed-rate drop
+    # the shed event records how many warming replicas were credited
+    rec = DummyRecorder()
+    b = AdmissionController(deadline_ms=800.0, recorder=rec)
+    b.observe(1.0)
+    assert b.check(depth=3, ready_replicas=1, warming_replicas=1) is not None
+    assert rec.events[-1][1]["warming_replicas"] == 1
+    # frac 0 restores the pre-autoscale behavior: warming buys nothing
+    c = AdmissionController(deadline_ms=800.0, warming_capacity_frac=0.0)
+    c.observe(1.0)
+    assert c.check(depth=1, ready_replicas=1, warming_replicas=5) is not None
+    assert a.snapshot()["warming_capacity_frac"] == 0.5
+    with pytest.raises(AssertionError):
+        AdmissionController(deadline_ms=100.0, warming_capacity_frac=1.5)
 
 
 # --- replica manager (injected seams; no sockets, no processes) ---------------
@@ -593,7 +629,8 @@ def test_fleet_metrics_aggregation(fleet_factory):
     assert snap["requests_total"] == 6 and snap["errors_total"] == 0
     for key in ("latency_s_p50", "latency_s_p95", "latency_s_p99"):
         assert snap[key] is not None and snap[key] > 0
-    assert snap["fleet"] == {"size": 2, "ready": 2, "in_flight": 0,
+    assert snap["fleet"] == {"size": 2, "ready": 2, "warming": 0,
+                             "in_flight": 0,
                              "replica_restarts": 0, "degraded": 0,
                              "degraded_seconds": 0.0,
                              # weight footprint summed over the replicas
@@ -856,6 +893,207 @@ def test_batcher_queue_full_typed_and_recovers():
         b.close()
 
 
+# --- cross-replica continuous batching (tentpole) -------------------------------
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode("ascii")
+
+
+def test_server_predict_batch_matches_single_contract():
+    """/predict_batch answers each item with the byte-identical JSON a lone
+    /predict would have produced (modulo the latency field), per-item
+    failures settle that item alone, and only an unparseable envelope
+    400s the whole call."""
+    from vitax.serve import stop_server
+    engine = FakeEngine()
+    httpd, ctx, url = _start(tiny_cfg(), engine)
+    try:
+        body = png_bytes(16, seed=2)
+        req = urllib.request.Request(
+            url + "/predict", data=body,
+            headers={"Content-Type": "image/png"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            single_raw = resp.read()
+        env = json.dumps({
+            "items": [_b64(body), _b64(png_bytes(16, seed=3)),
+                      _b64(b"not an image")],
+            "content_types": ["image/png", "image/png", "image/png"],
+        }).encode("utf-8")
+        out = post_bytes(url + "/predict_batch", env,
+                         content_type="application/json")
+        results = out["results"]
+        assert len(results) == 3
+        assert results[0]["status"] == 200 and results[1]["status"] == 200
+        # byte-identical up to latency_ms: same serializer, same engine
+        assert (results[0]["body"].encode("utf-8").split(b'"latency_ms"')[0]
+                == single_raw.split(b'"latency_ms"')[0])
+        parsed = json.loads(results[1]["body"])
+        assert len(parsed["classes"]) == 3 and len(parsed["probs"]) == 3
+        # the bad item 400s alone; the rest of the batch still answered
+        assert results[2]["status"] == 400
+        assert "bad request" in json.loads(results[2]["body"])["error"]
+        # malformed envelopes fail the whole call, not silently half of it
+        for bad in (b"not json{",
+                    json.dumps({"items": [_b64(body)],
+                                "content_types": ["image/png", "image/png"]
+                                }).encode("utf-8")):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post_bytes(url + "/predict_batch", bad,
+                           content_type="application/json")
+            assert e.value.code == 400
+    finally:
+        stop_server(httpd, ctx)
+
+
+class RecordingEngine(FakeEngine):
+    """FakeEngine that records every predict's batch size — the direct
+    measure of bucket fill the composer exists to raise."""
+
+    def __init__(self, delay_s=0.0):
+        super().__init__(delay_s)
+        self.batch_sizes = []
+        self._sizes_lock = threading.Lock()
+
+    def predict(self, images):
+        with self._sizes_lock:
+            self.batch_sizes.append(int(images.shape[0]))
+        return super().predict(images)
+
+
+def test_composer_two_replica_drill_raises_batch_fill():
+    """The acceptance drill: 4 sequential requests through the plain router
+    land as four batch-of-1 predicts (least-loaded spreading starves every
+    replica's batcher); the same 4 requests concurrent through the
+    composer ride ONE /predict_batch into one replica's batcher and fill a
+    bucket — with bitwise-identical classes/probs either way."""
+    from vitax.serve import stop_server
+    engines = [RecordingEngine(), RecordingEngine()]
+    servers = [_start(tiny_cfg(max_batch_wait_ms=100.0), e) for e in engines]
+    manager = ReplicaManager()
+    for i, (_, _, url) in enumerate(servers):
+        manager.adopt(url, name=f"r{i}")
+    manager.poll_once()
+    direct = Router(manager, request_timeout_s=30.0)
+    composed = Router(manager, request_timeout_s=30.0,
+                      batch_window_ms=400.0, batch_max=4)
+    body = png_bytes(16, seed=7)
+    try:
+        base = [direct.dispatch(body, "image/png") for _ in range(4)]
+        assert all(s == 200 for s, _, _ in base)
+        base_sizes = engines[0].batch_sizes + engines[1].batch_sizes
+        assert sorted(base_sizes) == [1, 1, 1, 1]  # every bucket ran at 1
+        for e in engines:
+            with e._sizes_lock:
+                e.batch_sizes.clear()
+        results = [None] * 4
+
+        def worker(i):
+            results[i] = composed.dispatch(body, "image/png")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(r is not None and r[0] == 200 for r in results)
+        comp_sizes = engines[0].batch_sizes + engines[1].batch_sizes
+        assert sum(comp_sizes) == 4
+        # fill rose: fewer dispatches than items, and the MEDIAN engine
+        # batch went from 1 to >= 2 (the batch-fill p50 acceptance bar)
+        assert len(comp_sizes) < 4 and max(comp_sizes) >= 2
+        assert sorted(comp_sizes)[len(comp_sizes) // 2] >= 2
+        snap = composed._composer.snapshot()
+        assert snap["items_total"] == 4 and snap["batches_total"] >= 1
+        assert snap["batch_fill_p50"] >= 0.5
+        assert snap["disabled"] is False
+        # composed answers are bitwise the direct answers (latency aside)
+        base_prefix = base[0][2].split(b'"latency_ms"')[0]
+        base_parsed = json.loads(base[0][2])
+        for status, _, payload in results:
+            assert payload.split(b'"latency_ms"')[0] == base_prefix
+            got = json.loads(payload)
+            assert got["classes"] == base_parsed["classes"]
+            assert got["probs"] == base_parsed["probs"]
+    finally:
+        composed.close()
+        for httpd, ctx, _ in servers:
+            stop_server(httpd, ctx)
+
+
+def test_composer_falls_back_when_batch_dispatch_fails():
+    """A failed or malformed /predict_batch never costs availability: the
+    group re-drives through the per-request direct path (FakeReplica
+    answers /predict_batch with a single-predict body — malformed as an
+    envelope — so every composed group falls back)."""
+    fake = FakeReplica("a")
+    manager = ReplicaManager()
+    manager.adopt(fake.url, name="a")
+    manager.poll_once()
+    router = Router(manager, request_timeout_s=10.0,
+                    batch_window_ms=50.0, batch_max=4)
+    try:
+        status, headers, payload = router.dispatch(png_bytes(), "image/png")
+        assert status == 200
+        assert json.loads(payload)["classes"] == [1, 0, 2]
+        snap = router._composer.snapshot()
+        assert snap["fallback_items_total"] == 1
+        assert snap["disabled"] is False  # malformed != unsupported
+        assert fake.predict_count == 2    # the bad batch try + the fallback
+    finally:
+        router.close()
+        fake.stop()
+
+
+def test_composer_disabled_on_unsupported_replica():
+    """A replica without /predict_batch (404 — mixed-version fleet) turns
+    composition off permanently for this router; later requests skip the
+    grouping wait and dispatch directly."""
+    rec = DummyRecorder()
+    fake = FakeReplica("a")
+    fake.batch_unsupported = True
+    manager = ReplicaManager()
+    manager.adopt(fake.url, name="a")
+    manager.poll_once()
+    router = Router(manager, recorder=rec, request_timeout_s=10.0,
+                    batch_window_ms=50.0, batch_max=4)
+    try:
+        status, _, payload = router.dispatch(png_bytes(), "image/png")
+        assert status == 200            # settled via fallback
+        snap = router._composer.snapshot()
+        assert snap["disabled"] is True
+        assert ("continuous_batching",
+                {"event": "disabled",
+                 "detail": "replica lacks /predict_batch"}) in rec.events
+        batches_before = snap["batches_total"]
+        status, _, _ = router.dispatch(png_bytes(seed=1), "image/png")
+        assert status == 200
+        assert (router._composer.snapshot()["batches_total"]
+                == batches_before)      # bypassed, not grouped
+        assert fake.predict_count == 2  # both served via the direct path
+        # the 404 was not charged as a dispatch failure
+        assert manager.find("a").dispatch_failures == 0
+    finally:
+        router.close()
+        fake.stop()
+
+
+def test_fleet_metrics_reports_continuous_batching(fleet_factory):
+    """A composer-enabled router surfaces its fill histogram in /metrics;
+    plain routers omit the block entirely (schema stays stable)."""
+    manager, router, url, fakes = fleet_factory(n=1)
+    assert "continuous_batching" not in router.fleet_metrics()
+    composed = Router(manager, request_timeout_s=10.0,
+                      batch_window_ms=25.0, batch_max=8)
+    try:
+        snap = composed.fleet_metrics()["continuous_batching"]
+        assert snap["window_ms"] == 25.0 and snap["batch_max"] == 8
+        assert snap["batches_total"] == 0 and snap["disabled"] is False
+    finally:
+        composed.close()
+
+
 # --- serve_bench fleet contract --------------------------------------------------
 
 
@@ -940,6 +1178,85 @@ def test_metrics_report_fleet_counters(tmp_path):
     summary = metrics_report.summarize(str(path))
     assert summary["admission_shed_count"] == 2
     assert summary["replica_restarts"] == 1
+
+
+def test_serve_bench_ramp_stages(fleet_factory):
+    """--ramp runs each stage against a wall-clock deadline and reports a
+    per-stage breakdown; the overall counters span all stages."""
+    serve_bench = _import_tool("serve_bench")
+    manager, router, url, fakes = fleet_factory(n=2)
+    summary = serve_bench.run_bench(
+        url, concurrency=2, requests_per_worker=0, image_size=16,
+        timeout=30.0, slo_p99_ms=5000.0, replicas=2, ramp="20:1")
+    assert len(summary["ramp"]) == 1
+    stage = summary["ramp"][0]
+    assert stage["target_rps"] == 20.0 and stage["duration_s"] == 1.0
+    assert stage["completed"] > 0 and stage["errors"] == 0
+    assert stage["latency_s_p50"] is not None
+    # overall counters are the sum of the stage counters
+    assert summary["requests"] == (summary["completed"] + summary["shed"]
+                                   + summary["unavailable"]
+                                   + summary["errors"])
+    assert summary["completed"] == stage["completed"]
+    # growth counters ride along whenever --replicas samples the router
+    assert summary["fleet"]["cache_hits"] == 0
+    assert summary["fleet"]["scale_events"] == 0
+    assert summary["slo"]["attained"] is True
+    json.dumps(summary)  # --json stays one serializable object
+
+
+def test_serve_bench_ramp_spec_validation():
+    serve_bench = _import_tool("serve_bench")
+    assert serve_bench.parse_ramp("5:2, 10:3") == [(5.0, 2.0), (10.0, 3.0)]
+    for bad in ("", "5", "0:1", "5:0", "5:-1", "rps:secs"):
+        with pytest.raises(ValueError):
+            serve_bench.parse_ramp(bad)
+
+
+def test_metrics_report_growth_counters(tmp_path):
+    """The growth telemetry round-trips through the JSONL: autoscale
+    actions bucketed by outcome, the cache hit rate recovered from the
+    LAST hit event's running totals, and batch fill percentiles from the
+    per-request batch_size/bucket fields."""
+    metrics_report = _import_tool("metrics_report")
+    path = tmp_path / "serve.jsonl"
+    records = [
+        {"schema": 1, "time": 1.0, "kind": "autoscale", "event": "scale_out",
+         "reason": "shed_rate", "size": 2},
+        {"schema": 1, "time": 2.0, "kind": "autoscale",
+         "event": "scale_out_failed", "detail": "agent down"},
+        {"schema": 1, "time": 3.0, "kind": "autoscale", "event": "retire",
+         "replica": "r0"},
+        {"schema": 1, "time": 4.0, "kind": "autoscale", "event": "scale_in",
+         "replica": "r0", "forced": False, "size": 1},
+        {"schema": 1, "time": 5.0, "kind": "autoscale", "event": "scale_in",
+         "replica": "r1", "forced": True, "size": 1},
+        {"schema": 1, "time": 6.0, "kind": "cache", "decision": "hit",
+         "hits_total": 2, "misses_total": 6},
+        {"schema": 1, "time": 7.0, "kind": "serve_request", "latency_s": 0.1,
+         "batch_size": 1, "bucket": 4},
+        {"schema": 1, "time": 8.0, "kind": "serve_request", "latency_s": 0.1,
+         "batch_size": 4, "bucket": 4, "batched": True},
+        {"schema": 1, "time": 9.0, "kind": "serve_request", "latency_s": 0.1,
+         "batch_size": 4, "bucket": 4, "batched": True},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    summary = metrics_report.summarize(str(path))
+    assert summary["autoscale_events"] == {
+        "scale_out": 1, "scale_in": 2, "retires": 1,
+        "scale_out_failures": 1, "forced_drains": 1}
+    assert summary["cache_hits"] == 2
+    assert summary["cache_hit_rate"] == 0.25
+    assert summary["batch_fill_p50"] == 1.0   # median of [0.25, 1.0, 1.0]
+    assert summary["batch_fill_p95"] == 1.0
+    # a log with no growth events keeps the old schema quiet
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps(
+        {"schema": 1, "time": 1.0, "kind": "serve_request",
+         "latency_s": 0.1}) + "\n")
+    bsum = metrics_report.summarize(str(bare))
+    assert not any(bsum["autoscale_events"].values())
+    assert "cache_hits" not in bsum and "batch_fill_p50" not in bsum
 
 
 # --- e2e: real replicas, kill one mid-burst (slow) --------------------------------
@@ -1028,5 +1345,138 @@ def test_fleet_e2e_kill_replica_zero_client_errors(devices8,
             if r.proc is not None and r.proc.poll() is None:
                 r.proc.kill()
     # the graceful-drain contract: SIGTERM -> in-flight answered -> exit 0
+    for r in manager.replicas:
+        assert r.exit_code == 0, manager.snapshot()
+
+
+@pytest.mark.slow
+def test_fleet_autoscale_ramp_drill(devices8, tmp_path_factory):
+    """The growth acceptance drill: one real replica with a slow-accelerator
+    fault plan (every engine predict hangs 250ms) behind an admission-
+    controlled router; a serve_bench ramp overloads it; the autoscaler
+    reads the sustained pressure and provisions a second real replica —
+    which enters through STARTING and is never served cold (zero errors,
+    zero restarts, SLO attained on everything completed). A flaky
+    health-probe chaos window runs in the router during the ramp and stays
+    invisible to clients. Afterwards the prediction cache is armed and a
+    repeated body is answered verbatim with ZERO extra engine predicts."""
+    from vitax.train.loop import train
+    serve_bench = _import_tool("serve_bench")
+
+    root = tmp_path_factory.mktemp("fleet_autoscale")
+    ckpt_dir = str(root / "ckpt")
+    cfg = tiny_cfg(fake_data=True, num_epochs=1, steps_per_epoch=2,
+                   log_step_interval=1, ckpt_dir=ckpt_dir,
+                   ckpt_epoch_interval=1, num_workers=2, eval_max_batches=1)
+    train(cfg)
+
+    model_flags = [
+        "--image_size", "16", "--patch_size", "8", "--embed_dim", "32",
+        "--num_heads", "2", "--num_blocks", "2", "--num_classes", "4",
+        "--dtype", "float32", "--serve_max_batch", "4", "--serve_topk", "3",
+        "--max_batch_wait_ms", "10.0", "--ckpt_dir", ckpt_dir,
+        "--epoch", "1",
+    ]
+    # the seed replica's chaos: a slow accelerator (every predict +250ms),
+    # so offered load beyond ~1 batch in flight predictably queues
+    slow_plan = json.dumps({"site": "engine_predict", "at": 1,
+                            "times": 1000000, "action": "hang",
+                            "seconds": 0.25})
+    rec = DummyRecorder()
+    manager = ReplicaManager(health_interval_s=0.25, backoff_s=0.5)
+    admission = AdmissionController(deadline_ms=400.0, ewma_alpha=0.0,
+                                    recorder=rec)
+    admission.observe(0.2)  # alpha 0: the service-time estimate stays 0.2s
+
+    def spawn_second():
+        port = free_port()
+        argv = ([sys.executable, "-m", "vitax.serve"] + model_flags
+                + ["--serve_port", str(port)])
+        return manager.manage(argv, f"http://127.0.0.1:{port}",
+                              name="scaled_1")
+
+    auto = Autoscaler(manager, admission=admission, min_replicas=1,
+                      max_replicas=2, scale_out=spawn_second,
+                      interval_s=0.25, dwell_s=0.75, cooldown_s=60.0,
+                      shed_rate_per_s=0.5, recorder=rec)
+    router = Router(manager, admission=admission, autoscaler=auto,
+                    request_timeout_s=60.0)
+    httpd = None
+    try:
+        port = free_port()
+        argv = ([sys.executable, "-m", "vitax.serve"] + model_flags
+                + ["--serve_port", str(port), "--fault_plan", slow_plan])
+        manager.manage(argv, f"http://127.0.0.1:{port}", name="replica_0")
+        manager.start()
+        deadline = time.time() + 300
+        while manager.ready_count() < 1 and time.time() < deadline:
+            time.sleep(0.5)
+        assert manager.ready_count() == 1, manager.snapshot()
+
+        httpd = start_router(router, 0)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        auto.start()
+        # router-side chaos: one health probe fails mid-ramp — below the
+        # ejection threshold, so clients must never notice
+        faults.install(json.dumps({"site": "replica_health", "at": 8,
+                                   "action": "oserror"}))
+        try:
+            summary = serve_bench.run_bench(
+                url, concurrency=6, requests_per_worker=0, image_size=16,
+                timeout=60.0, slo_p99_ms=5000.0, replicas=2, ramp="40:10")
+        finally:
+            faults.uninstall()
+            auto.stop()  # no idle scale-in racing the cache phase below
+
+        # zero cold serves / zero client-visible errors under chaos
+        assert summary["errors"] == 0, summary["error_samples"]
+        assert summary["completed"] > 0
+        assert summary["fleet"]["replica_restarts"] == 0
+        assert summary["slo"]["attained"] is True
+        # the ramp actually overloaded the seed replica...
+        assert summary["shed"] > 0
+        # ...and the autoscaler answered: scale-out visible in the bench
+        assert summary["fleet"]["scale_out"] >= 1
+        assert summary["fleet"]["scale_events"] >= 1
+        assert auto.scale_out_total == 1  # cooldown + max clamp: exactly one
+        out_events = [p for k, p in rec.events
+                      if k == "autoscale" and p.get("event") == "scale_out"]
+        assert out_events and out_events[0]["replica"] == "scaled_1"
+
+        # the provisioned replica finishes AOT warmup and joins rotation
+        # through the front door (STARTING until its own /healthz is ready)
+        deadline = time.time() + 300
+        while manager.ready_count() < 2 and time.time() < deadline:
+            time.sleep(0.5)
+        assert manager.ready_count() == 2, manager.snapshot()
+
+        # arm the cache and pin the replay contract on the live fleet:
+        # a repeated body costs zero engine predicts
+        router.cache = PredictionCache(max_entries=16)
+        body = png_bytes(16, seed=9)
+
+        def raw_post():
+            req = urllib.request.Request(
+                url + "/predict", data=body,
+                headers={"Content-Type": "image/png"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        s1, h1, b1 = raw_post()
+        assert s1 == 200 and "X-Vitax-Cache" not in h1
+        dispatched = router.metrics.requests_total
+        s2, h2, b2 = raw_post()
+        assert s2 == 200 and h2.get("X-Vitax-Cache") == "hit"
+        assert b2 == b1                                   # bitwise replay
+        assert router.metrics.requests_total == dispatched  # no dispatch
+        assert router.cache.snapshot()["hits_total"] == 1
+    finally:
+        faults.uninstall()
+        auto.stop()
+        if httpd is not None:
+            stop_router(httpd)
+        manager.stop()  # SIGTERM drain
+        for r in manager.replicas:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.kill()
     for r in manager.replicas:
         assert r.exit_code == 0, manager.snapshot()

@@ -26,7 +26,10 @@ for path in vitax/telemetry tools/metrics_report.py \
             vitax/serve/fleet/breaker.py tests/test_chaos.py \
             vitax/serve/quant.py tests/test_quant.py \
             vitax/ops/fused_optimizer.py tests/test_fused_optimizer.py \
-            vitax/ops/dequant_matmul.py tests/test_dequant_matmul.py; do
+            vitax/ops/dequant_matmul.py tests/test_dequant_matmul.py \
+            vitax/serve/fleet/autoscale.py vitax/serve/fleet/placement.py \
+            vitax/serve/fleet/agent.py vitax/serve/fleet/cache.py \
+            tests/test_cache.py tests/test_autoscale.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1

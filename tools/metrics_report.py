@@ -122,6 +122,40 @@ def summarize(path: str) -> dict:
             float(e.get("degraded_s", 0.0)) for e in events
             if e.get("kind") == "brownout" and e.get("event") == "exit"), 3),
     }
+    # fleet growth (vitax/serve/fleet/autoscale.py): scaling actions by
+    # outcome, mirroring the control_events bucket style
+    autoscale = [e for e in events if e.get("kind") == "autoscale"]
+    summary["autoscale_events"] = {
+        "scale_out": sum(1 for e in autoscale
+                         if e.get("event") == "scale_out"),
+        "scale_in": sum(1 for e in autoscale
+                        if e.get("event") == "scale_in"),
+        "retires": sum(1 for e in autoscale if e.get("event") == "retire"),
+        "scale_out_failures": sum(1 for e in autoscale
+                                  if e.get("event") == "scale_out_failed"),
+        "forced_drains": sum(1 for e in autoscale
+                             if e.get("event") == "scale_in"
+                             and e.get("forced")),
+    }
+    # prediction cache (vitax/serve/fleet/cache.py): hit events carry
+    # running totals, so the LAST one yields the rate (misses are counted
+    # router-side but deliberately not emitted per-event)
+    cache_events = [e for e in events if e.get("kind") == "cache"]
+    if cache_events:
+        last_cache = cache_events[-1]
+        hits = int(last_cache.get("hits_total", len(cache_events)))
+        misses = int(last_cache.get("misses_total", 0))
+        summary["cache_hits"] = hits
+        summary["cache_hit_rate"] = round(hits / max(hits + misses, 1), 4)
+    # batch fill (serve_request events from replicas): how full the padded
+    # bucket each request ran in actually was — the continuous-batching
+    # acceptance metric (composed dispatch raises the p50)
+    fills = sorted(e["batch_size"] / max(e.get("bucket", 1), 1)
+                   for e in events
+                   if e.get("kind") == "serve_request" and "batch_size" in e)
+    if fills:
+        summary["batch_fill_p50"] = round(percentile(fills, 0.50), 4)
+        summary["batch_fill_p95"] = round(percentile(fills, 0.95), 4)
     # control plane (vitax/train/control.py + the supervisor's elastic
     # restarts): kind:"control" records, bucketed by their `event` field
     control = [e for e in events if e.get("kind") == "control"]
@@ -287,6 +321,17 @@ def print_human(summary: dict) -> None:
     if summary.get("brownout_seconds"):
         print(f"  !! brownout (degraded mode): "
               f"{summary['brownout_seconds']:.1f}s across completed episodes")
+    auto = summary.get("autoscale_events") or {}
+    if any(auto.values()):
+        print(f"  autoscale: {auto['scale_out']} out, {auto['scale_in']} in "
+              f"({auto['retires']} retires, {auto['forced_drains']} forced "
+              f"drains, {auto['scale_out_failures']} failed provisions)")
+    if summary.get("cache_hits") is not None:
+        print(f"  prediction cache: {summary['cache_hits']} hits "
+              f"(rate {summary['cache_hit_rate']:.2f})")
+    if summary.get("batch_fill_p50") is not None:
+        print(f"  batch fill: p50 {summary['batch_fill_p50']:.2f}  "
+              f"p95 {summary['batch_fill_p95']:.2f} of bucket")
     ev = summary.get("eval_last")
     if ev:
         print(f"  eval (epoch {ev['epoch']}): top1 {ev['top1']:.4f}  "

@@ -25,7 +25,13 @@ Fleet mode (target = a vitax.serve.fleet router):
 - `--replicas N` samples the router's /metrics during the run and reports
   rotation (ready_min/ready_end) and replica_restarts — a kill-a-replica
   drill shows up here, not in the error count — plus the containment
-  counters (hedged, breaker_opens, degraded_seconds, retry budget);
+  counters (hedged, breaker_opens, degraded_seconds, retry budget) and
+  the fleet-growth counters (cache_hits, cache_hit_rate, scale_events,
+  ready_max) when the router runs with a cache/autoscaler;
+- `--ramp "rps:secs,rps:secs,..."` replaces the fixed request count with
+  a staged offered-load profile (each stage paces to its rps for its
+  duration) — the autoscale acceptance drill's load shape. The summary
+  gains a per-stage breakdown under "ramp";
 - errors carry a taxonomy: `errors_by_class` buckets connection_refused /
   reset_mid_body / timeout / http_5xx / other, so a drill can assert
   *which* failure mode leaked to clients, not just how many;
@@ -117,18 +123,45 @@ def _retry_after_s(e: urllib.error.HTTPError) -> float:
         return 1.0
 
 
+def parse_ramp(spec: str):
+    """"rps:secs,rps:secs,..." -> [(rps, secs), ...] with validation."""
+    stages = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            rps_s, secs_s = part.split(":", 1)
+            rps, secs = float(rps_s), float(secs_s)
+        except ValueError:
+            raise ValueError(
+                f"bad --ramp stage {part!r}: want 'rps:secs'") from None
+        if rps <= 0 or secs <= 0:
+            raise ValueError(f"--ramp stage {part!r}: rps and secs must be "
+                             f"> 0")
+        stages.append((rps, secs))
+    if not stages:
+        raise ValueError(f"--ramp {spec!r} has no stages")
+    return stages
+
+
 def run_worker(url: str, body: bytes, n_requests: int, timeout: float,
                latencies: list, errors: list, lock: threading.Lock,
                sheds: list = None, interval_s: float = 0.0,
-               unavailable: list = None) -> None:
+               unavailable: list = None, deadline: float = 0.0) -> None:
     """One closed-loop worker. `interval_s` > 0 paces to an offered rate
     (open-ish loop: sleep out the remainder of the interval after each
     response); `sheds` collects 429 admission responses separately from
     errors — shedding under overload is contract behavior, not failure —
     and `unavailable` likewise collects 503+Retry-After (the fleet's
     bounded-degradation answer: retry budget dry, no ready replicas).
-    `errors` entries are (class, detail) pairs — see classify_error."""
-    for _ in range(n_requests):
+    `errors` entries are (class, detail) pairs — see classify_error.
+    `deadline` > 0 switches to time-bounded mode (ramp stages): loop
+    until the wall clock passes it, ignoring n_requests."""
+    sent = 0
+    while ((time.time() < deadline) if deadline > 0
+           else (sent < n_requests)):
+        sent += 1
         req = urllib.request.Request(
             url + "/predict", data=body,
             headers={"Content-Type": "image/png"})
@@ -175,6 +208,7 @@ class FleetSampler:
         self.url = url
         self.period_s = period_s
         self.ready_min = None
+        self.ready_max = None
         self.ready_end = None
         self.fleet_size = None
         self.restarts_end = 0
@@ -183,6 +217,13 @@ class FleetSampler:
         self.breaker_opens = 0
         self.degraded_seconds = 0.0
         self.retry_budget_exhausted = 0
+        # fleet-growth counters (PR 17): absent keys stay at their zeros,
+        # so benching a cache-less/static fleet still reports cleanly
+        self.cache_hits = 0
+        self.cache_hit_rate = None
+        self.scale_events = 0
+        self.scale_out = 0
+        self.scale_in = 0
         # _sample runs on both the poll thread and the start/stop callers
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -203,6 +244,8 @@ class FleetSampler:
                 self.ready_end = ready
                 self.ready_min = (ready if self.ready_min is None
                                   else min(self.ready_min, ready))
+                self.ready_max = (ready if self.ready_max is None
+                                  else max(self.ready_max, ready))
             self.fleet_size = fleet.get("size", self.fleet_size)
             self.restarts_end = fleet.get("replica_restarts",
                                           self.restarts_end)
@@ -220,6 +263,18 @@ class FleetSampler:
             self.retry_budget_exhausted = max(
                 self.retry_budget_exhausted,
                 budget.get("exhausted_total", 0))
+            self.cache_hits = max(self.cache_hits,
+                                  snap.get("cache_hits", 0))
+            rate = snap.get("cache_hit_rate")
+            if rate is not None:
+                self.cache_hit_rate = rate
+            self.scale_events = max(self.scale_events,
+                                    snap.get("scale_events", 0))
+            auto = snap.get("autoscale") or {}
+            self.scale_out = max(self.scale_out,
+                                 auto.get("scale_out_total", 0))
+            self.scale_in = max(self.scale_in,
+                                auto.get("scale_in_total", 0))
 
     def _loop(self) -> None:
         while not self._stop.wait(timeout=self.period_s):
@@ -237,6 +292,7 @@ class FleetSampler:
             return {
                 "replicas": self.fleet_size,
                 "ready_min": self.ready_min,
+                "ready_max": self.ready_max,
                 "ready_end": self.ready_end,
                 "replica_restarts": self.restarts_end,
                 "hedged": self.hedged,
@@ -244,6 +300,11 @@ class FleetSampler:
                 "breaker_opens": self.breaker_opens,
                 "degraded_seconds": round(self.degraded_seconds, 3),
                 "retry_budget_exhausted": self.retry_budget_exhausted,
+                "cache_hits": self.cache_hits,
+                "cache_hit_rate": self.cache_hit_rate,
+                "scale_events": self.scale_events,
+                "scale_out": self.scale_out,
+                "scale_in": self.scale_in,
             }
 
 
@@ -344,13 +405,14 @@ def scrape_weights(url: str, timeout: float = 2.0):
 def run_bench(url: str, concurrency: int, requests_per_worker: int,
               image_size: int, timeout: float, serve_jsonl: str = "",
               target_rps: float = 0.0, slo_p99_ms: float = 0.0,
-              replicas: int = 0, chaos: str = "") -> dict:
+              replicas: int = 0, chaos: str = "", ramp: str = "") -> dict:
     body = make_image_bytes(image_size)
     latencies: list = []
     errors: list = []
     sheds: list = []
     unavailable: list = []
     lock = threading.Lock()
+    stages = parse_ramp(ramp) if ramp else []
     # pacing: each of C workers owns 1/C of the offered rate
     interval_s = concurrency / target_rps if target_rps > 0 else 0.0
     chaos_installed = install_chaos(url, chaos) if chaos else None
@@ -358,32 +420,64 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
     if sampler is not None:
         sampler.start()
     t_start = time.time()
-    workers = [threading.Thread(
-        target=run_worker,
-        args=(url, body, requests_per_worker, timeout, latencies, errors,
-              lock, sheds, interval_s, unavailable), daemon=True)
-        for _ in range(concurrency)]
-    for w in workers:
-        w.start()
-    for w in workers:
-        w.join()
+    stage_reports = []
+    if stages:
+        # staged offered-load profile: each stage paces its own workers
+        # against a wall-clock deadline; the aggregate lists span all
+        # stages so the overall summary covers the whole profile
+        for rps, secs in stages:
+            stage_interval = concurrency / rps
+            counts0 = (len(latencies), len(sheds), len(unavailable),
+                       len(errors))
+            deadline = time.time() + secs
+            workers = [threading.Thread(
+                target=run_worker,
+                args=(url, body, 0, timeout, latencies, errors, lock,
+                      sheds, stage_interval, unavailable, deadline),
+                daemon=True) for _ in range(concurrency)]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+            stage_lat = sorted(latencies[counts0[0]:])
+            stage_reports.append({
+                "target_rps": rps,
+                "duration_s": secs,
+                "completed": len(stage_lat),
+                "shed": len(sheds) - counts0[1],
+                "unavailable": len(unavailable) - counts0[2],
+                "errors": len(errors) - counts0[3],
+                "latency_s_p50": percentile(stage_lat, 0.50),
+                "latency_s_p99": percentile(stage_lat, 0.99),
+            })
+    else:
+        workers = [threading.Thread(
+            target=run_worker,
+            args=(url, body, requests_per_worker, timeout, latencies,
+                  errors, lock, sheds, interval_s, unavailable),
+            daemon=True) for _ in range(concurrency)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
     elapsed = time.time() - t_start
     lat = sorted(latencies)
     by_class: dict = {}
     for cls, _ in errors:
         by_class[cls] = by_class.get(cls, 0) + 1
+    attempted = (len(lat) + len(errors) + len(sheds) + len(unavailable)
+                 if stages else concurrency * requests_per_worker)
     summary = {
         "url": url,
         "concurrency": concurrency,
-        "requests": concurrency * requests_per_worker,
+        "requests": attempted,
         "completed": len(lat),
         "errors": len(errors),
         "errors_by_class": by_class,
         "error_samples": [msg for _, msg in errors[:3]],
         "shed": len(sheds),
         "unavailable": len(unavailable),
-        "shed_fraction": round(
-            len(sheds) / max(concurrency * requests_per_worker, 1), 4),
+        "shed_fraction": round(len(sheds) / max(attempted, 1), 4),
         "elapsed_s": round(elapsed, 3),
         "throughput_rps": round(len(lat) / max(elapsed, 1e-9), 3),
         "achieved_rps": round(
@@ -393,6 +487,8 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
         "latency_s_p99": percentile(lat, 0.99),
         "latency_s_mean": (round(sum(lat) / len(lat), 6) if lat else None),
     }
+    if stage_reports:
+        summary["ramp"] = stage_reports
     if slo_p99_ms > 0:
         p99 = summary["latency_s_p99"]
         summary["slo"] = {
@@ -444,6 +540,21 @@ def print_human(s: dict) -> None:
                   f"{fleet['breaker_opens']} breaker opens, "
                   f"{fleet['retry_budget_exhausted']} budget-exhausted, "
                   f"degraded {fleet['degraded_seconds']:.1f}s")
+        if fleet.get("scale_events") or fleet.get("cache_hits"):
+            rate = fleet.get("cache_hit_rate")
+            print(f"  growth: {fleet.get('scale_events', 0)} scale events "
+                  f"({fleet.get('scale_out', 0)} out, "
+                  f"{fleet.get('scale_in', 0)} in, ready peaked at "
+                  f"{fleet.get('ready_max')}), "
+                  f"{fleet.get('cache_hits', 0)} cache hits"
+                  + (f" (rate {rate:.2f})" if rate is not None else ""))
+    for i, st in enumerate(s.get("ramp") or []):
+        p99 = st["latency_s_p99"]
+        print(f"  ramp[{i}] {st['target_rps']:g} rps x "
+              f"{st['duration_s']:g}s: {st['completed']} ok, "
+              f"{st['shed']} shed, {st['unavailable']} unavailable, "
+              f"{st['errors']} errors"
+              + (f", p99 {1e3 * p99:.1f}ms" if p99 is not None else ""))
     weights = s.get("weights")
     if weights:
         print(f"  weights: {weights['weights_dtype']} "
@@ -487,6 +598,10 @@ def main(argv=None) -> int:
                    help="fault plan JSON (vitax/faults.py grammar) POSTed "
                         "to every replica's /chaos before the burst — "
                         "replicas must run with --serve_allow_chaos")
+    p.add_argument("--ramp", type=str, default="",
+                   help="staged offered-load profile 'rps:secs,rps:secs,"
+                        "...' (replaces --requests/--target_rps; the "
+                        "autoscale drill's load shape)")
     p.add_argument("--json", action="store_true",
                    help="emit the summary as one JSON object (CI mode)")
     args = p.parse_args(argv)
@@ -495,7 +610,7 @@ def main(argv=None) -> int:
                         args.image_size, args.timeout, args.serve_jsonl,
                         target_rps=args.target_rps,
                         slo_p99_ms=args.slo_p99_ms, replicas=args.replicas,
-                        chaos=args.chaos)
+                        chaos=args.chaos, ramp=args.ramp)
     if args.json:
         print(json.dumps(summary, sort_keys=True))
     else:

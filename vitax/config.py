@@ -248,6 +248,19 @@ class Config:
     #   into running replicas (tools/serve_bench.py --chaos). NEVER enable
     #   on a production replica — the endpoint is deliberately off unless
     #   this flag opts in
+    serve_cache_max: int = 0            # router-side content-addressed prediction cache: entries
+    #   kept (0 = off). Keyed by SHA-256 of the request bytes + topk;
+    #   exact, because AOT-pinned classification is deterministic — a hit
+    #   returns the stored bytes verbatim without touching a replica
+    serve_cache_ttl_s: float = 300.0    # prediction-cache entry lifetime; expired entries re-dispatch
+    #   (bounds staleness across model redeploys that keep the router up)
+    serve_batch_window_ms: float = 0.0  # cross-replica continuous batching (fleet router): hold the
+    #   first concurrent /predict up to this long to compose a group,
+    #   dispatched as ONE /predict_batch to one replica (0 = off).
+    #   Counters the least-loaded router spreading co-arrivals so thin
+    #   that every replica batcher flushes at batch_size 1
+    serve_batch_max: int = 0            # composed-group size cap (0 = use --serve_max_batch, the
+    #   largest engine bucket — bigger groups would split anyway)
 
     @property
     def resolved_param_gather_dtype(self) -> str:
@@ -527,6 +540,19 @@ class Config:
         assert self.serve_brownout_dwell_s >= 0, (
             f"--serve_brownout_dwell_s must be >= 0, got "
             f"{self.serve_brownout_dwell_s}")
+        assert self.serve_cache_max >= 0, (
+            f"--serve_cache_max must be >= 0 (0 = prediction cache off), "
+            f"got {self.serve_cache_max}")
+        assert self.serve_cache_ttl_s > 0, (
+            f"--serve_cache_ttl_s must be > 0, got {self.serve_cache_ttl_s}: "
+            f"a cache that never expires would replay answers across model "
+            f"redeploys; disable the cache with --serve_cache_max 0 instead")
+        assert self.serve_batch_window_ms >= 0, (
+            f"--serve_batch_window_ms must be >= 0 (0 = cross-replica "
+            f"continuous batching off), got {self.serve_batch_window_ms}")
+        assert self.serve_batch_max >= 0, (
+            f"--serve_batch_max must be >= 0 (0 = use --serve_max_batch), "
+            f"got {self.serve_batch_max}")
         assert self.serve_brownout_wait_ms >= 0, (
             f"--serve_brownout_wait_ms must be >= 0 (0 = flush every "
             f"request immediately while degraded), got "
@@ -818,6 +844,20 @@ def build_parser() -> argparse.ArgumentParser:
                        help="arm POST /chaos (accepts a vitax/faults.py "
                             "plan JSON body, installed live) for chaos "
                             "drills — never enable in production")
+    serve.add_argument("--serve_cache_max", type=int, default=0,
+                       help="fleet router prediction-cache entries "
+                            "(0 = off); exact content-addressed hits "
+                            "bypass dispatch entirely")
+    serve.add_argument("--serve_cache_ttl_s", type=float, default=300.0,
+                       help="prediction-cache entry lifetime in seconds")
+    serve.add_argument("--serve_batch_window_ms", type=float, default=0.0,
+                       help="fleet router cross-replica continuous "
+                            "batching window (0 = off): concurrent "
+                            "/predict bodies compose into one "
+                            "/predict_batch per group")
+    serve.add_argument("--serve_batch_max", type=int, default=0,
+                       help="composed-group size cap "
+                            "(0 = --serve_max_batch)")
     return parser
 
 

@@ -19,11 +19,36 @@ Three layers, bottom up:
 - admission.py — AdmissionController: predicted-wait 429 shedding with
                  Retry-After against the --slo_p99_ms deadline.
 
-Clients see the single-engine contract unchanged; tests/test_fleet.py
-pins the rotation, retry, and overload behaviors.
+The growth tier (this PR) composes on top:
+- autoscale.py — Autoscaler: hysteretic scale-out on sustained sheds /
+                 predicted-wait overshoot / brownout, scale-in (retire ->
+                 drain -> discard) on sustained idleness, clamped to
+                 [--min_replicas, --max_replicas];
+- placement.py — PlacementAgent (per-host replica factory over its own
+                 ReplicaManager, python -m vitax.serve.fleet.agent) +
+                 PlacementClient: cross-host provisioning the router
+                 adopts over the adopt() contract;
+- cache.py     — PredictionCache: router-side content-addressed response
+                 cache (SHA-256 of bytes + topk), exact under
+                 deterministic AOT classification; hits bypass dispatch;
+- router.py    — BatchComposer: cross-replica continuous batching —
+                 concurrent /predict bodies compose into one
+                 /predict_batch so one replica's batcher fills a bucket
+                 instead of N batchers timing out at size 1.
+
+Clients see the single-engine contract unchanged; tests/test_fleet.py,
+test_autoscale.py, and test_cache.py pin the behaviors.
 """
 
 from vitax.serve.fleet.admission import AdmissionController  # noqa: F401
+from vitax.serve.fleet.autoscale import Autoscaler  # noqa: F401
+from vitax.serve.fleet.cache import PredictionCache  # noqa: F401
+from vitax.serve.fleet.placement import (  # noqa: F401
+    PlacementAgent,
+    PlacementClient,
+    start_agent,
+    stop_agent,
+)
 from vitax.serve.fleet.breaker import (  # noqa: F401
     CircuitBreaker,
     RetryBudget,
@@ -37,6 +62,7 @@ from vitax.serve.fleet.replica import (  # noqa: F401
     ReplicaManager,
 )
 from vitax.serve.fleet.router import (  # noqa: F401
+    BatchComposer,
     Router,
     RouterMetrics,
     start_router,

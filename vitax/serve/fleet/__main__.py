@@ -31,6 +31,14 @@ _FLEET_ONLY_FLAGS = (
     # router-side failure containment (vitax/serve/fleet/breaker.py):
     "--breaker_threshold", "--breaker_cooldown_s", "--retry_budget_ratio",
     "--hedge_after_ms",
+    # autoscaling + cross-host placement (this PR's fleet growth tier):
+    "--min_replicas", "--max_replicas", "--warming_capacity_frac",
+    "--autoscale_dwell_s", "--autoscale_cooldown_s", "--autoscale_idle_frac",
+    "--placement_agents",
+    # router-side caching/batching knobs (Config fields, but meaningless
+    # inside a replica process — keep its argv clean):
+    "--serve_cache_max", "--serve_cache_ttl_s", "--serve_batch_window_ms",
+    "--serve_batch_max",
     # replica-specific overrides the fleet re-issues per replica:
     "--serve_port", "--metrics_dir",
 )
@@ -110,13 +118,48 @@ def main(argv=None) -> int:
                             "exceeds max(this, rolling p99), fire a second "
                             "attempt on another replica — first response "
                             "wins, bounded by the retry budget (0 = off)")
+    fleet.add_argument("--min_replicas", type=int, default=0,
+                       help="autoscaler floor (0 = --replicas); a fleet "
+                            "below it is repaired regardless of traffic")
+    fleet.add_argument("--max_replicas", type=int, default=0,
+                       help="autoscaler ceiling; > 0 turns the autoscaler "
+                            "on (scale-out on sustained sheds / predicted-"
+                            "wait overshoot / brownout, scale-in on "
+                            "sustained idleness; 0 = static fleet)")
+    fleet.add_argument("--warming_capacity_frac", type=float, default=0.5,
+                       help="admission counts a live-but-warming replica as "
+                            "this fraction of a ready one, so mid-scale-out "
+                            "sheds relax toward the new capacity")
+    fleet.add_argument("--autoscale_dwell_s", type=float, default=3.0,
+                       help="a scale signal must hold this long before the "
+                            "autoscaler acts (blips never scale)")
+    fleet.add_argument("--autoscale_cooldown_s", type=float, default=10.0,
+                       help="dead time after every scaling action, so one "
+                            "decision's consequences are observed before "
+                            "the next")
+    fleet.add_argument("--autoscale_idle_frac", type=float, default=0.25,
+                       help="scale-in trigger: in-flight per ready replica "
+                            "sustained at or below this with zero sheds")
+    fleet.add_argument("--placement_agents", type=str, default="",
+                       help="comma-separated placement-agent URLs (python "
+                            "-m vitax.serve.fleet.agent, one per host); "
+                            "replicas and scale-outs round-robin across "
+                            "them instead of spawning locally")
     ns = parser.parse_args(argv)
     cfg = Config(**config_fields_from_namespace(ns)).validate()
     assert ns.replicas >= 1, f"--replicas must be >= 1, got {ns.replicas}"
+    min_replicas = ns.min_replicas or ns.replicas
+    if ns.max_replicas:
+        assert min_replicas <= ns.max_replicas, (
+            f"--min_replicas {min_replicas} must be <= --max_replicas "
+            f"{ns.max_replicas}")
     base_port = ns.base_port or cfg.serve_port + 1
 
     from vitax.serve.server import build_serve_recorder
     from vitax.serve.fleet.admission import AdmissionController
+    from vitax.serve.fleet.autoscale import Autoscaler
+    from vitax.serve.fleet.cache import PredictionCache
+    from vitax.serve.fleet.placement import PlacementClient
     from vitax.serve.fleet.replica import ReplicaManager
     from vitax.serve.fleet.router import Router, start_router, stop_router
 
@@ -135,25 +178,84 @@ def main(argv=None) -> int:
         recorder=recorder, health_interval_s=ns.health_interval_s,
         fail_threshold=ns.fail_threshold,
         max_restarts=ns.replica_max_restarts)
-    for i in range(ns.replicas):
+
+    # -- provisioning: local spawn, or round-robin across placement agents.
+    # One closure serves both the initial fleet and autoscaler scale-outs,
+    # so a grown replica is indistinguishable from a boot-time one.
+    agents = [PlacementClient(u.strip())
+              for u in ns.placement_agents.split(",") if u.strip()]
+    placed: dict = {}          # local name -> (client, remote name)
+    spawn_state = {"next": 0, "rr": 0}
+    spawn_lock = threading.Lock()
+
+    def spawn_replica():
+        with spawn_lock:
+            i = spawn_state["next"]
+            spawn_state["next"] += 1
+            rr = spawn_state["rr"]
+            spawn_state["rr"] += 1
+        name = f"replica_{i}"
+        if agents:
+            client = agents[rr % len(agents)]
+            out = client.provision(strip_flags(argv, _FLEET_ONLY_FLAGS),
+                                   name=name)
+            replica = manager.adopt(out["url"], name=name)
+            with spawn_lock:
+                placed[name] = (client, out["name"])
+            return replica
         port = base_port + i
         metrics_dir = (os.path.join(cfg.metrics_dir, f"replica_{i}")
                        if cfg.metrics_dir else "")
-        manager.manage(replica_argv(argv, port, metrics_dir),
-                       f"http://127.0.0.1:{port}", name=f"replica_{i}")
+        return manager.manage(replica_argv(argv, port, metrics_dir),
+                              f"http://127.0.0.1:{port}", name=name)
+
+    def release_replica(replica):
+        # scale-in epilogue: a locally managed replica was already
+        # SIGTERM-drained by discard(); a placed one must also be freed on
+        # its agent so the remote process never leaks
+        with spawn_lock:
+            entry = placed.pop(replica.name, None)
+        if entry is not None:
+            client, remote_name = entry
+            client.release(remote_name)
+
+    for _ in range(ns.replicas):
+        spawn_replica()
     manager.start()
 
-    admission = AdmissionController(ns.slo_p99_ms, recorder=recorder)
+    admission = AdmissionController(
+        ns.slo_p99_ms, recorder=recorder,
+        warming_capacity_frac=ns.warming_capacity_frac)
+    autoscaler = None
+    if ns.max_replicas > 0:
+        autoscaler = Autoscaler(
+            manager, admission=admission, min_replicas=min_replicas,
+            max_replicas=ns.max_replicas, scale_out=spawn_replica,
+            release=release_replica, dwell_s=ns.autoscale_dwell_s,
+            cooldown_s=ns.autoscale_cooldown_s,
+            idle_occupancy=ns.autoscale_idle_frac, recorder=recorder)
+        autoscaler.start()
+    cache = (PredictionCache(cfg.serve_cache_max,
+                             ttl_s=cfg.serve_cache_ttl_s, recorder=recorder)
+             if cfg.serve_cache_max > 0 else None)
     router = Router(manager, admission=admission, recorder=recorder,
                     request_timeout_s=cfg.serve_request_timeout_s,
                     breaker_threshold=ns.breaker_threshold,
                     breaker_cooldown_s=ns.breaker_cooldown_s,
                     retry_budget_ratio=ns.retry_budget_ratio,
-                    hedge_after_ms=ns.hedge_after_ms)
+                    hedge_after_ms=ns.hedge_after_ms,
+                    cache=cache, autoscaler=autoscaler,
+                    batch_window_ms=cfg.serve_batch_window_ms,
+                    batch_max=cfg.serve_batch_max or cfg.serve_max_batch)
     httpd = start_router(router, cfg.serve_port)
+    scale_desc = (f"autoscale [{min_replicas}, {ns.max_replicas}]"
+                  if autoscaler is not None else "static")
     print(f"fleet: router on :{httpd.server_address[1]}, {ns.replicas} "
-          f"replicas on :{base_port}..:{base_port + ns.replicas - 1} "
-          f"(slo_p99_ms {ns.slo_p99_ms or 'off'})", flush=True)
+          f"replicas ({'placed' if agents else f'on :{base_port}..'}), "
+          f"{scale_desc}, slo_p99_ms {ns.slo_p99_ms or 'off'}, "
+          f"cache {cfg.serve_cache_max or 'off'}, "
+          f"batch_window_ms {cfg.serve_batch_window_ms or 'off'}",
+          flush=True)
 
     stop = threading.Event()
 
@@ -169,8 +271,15 @@ def main(argv=None) -> int:
         pass
     print("fleet: shutting down (router first, then replica drains)",
           flush=True)
-    stop_router(httpd)
+    stop_router(httpd, router)
+    if autoscaler is not None:
+        autoscaler.stop()
     manager.stop()  # SIGTERM-drains each replica: in-flight answered
+    for name, (client, remote_name) in list(placed.items()):
+        try:
+            client.release(remote_name)
+        except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] best-effort: the agent also drains on its own shutdown
+            pass
     if recorder is not None:
         recorder.close()
     return 0

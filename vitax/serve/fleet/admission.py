@@ -33,10 +33,13 @@ class AdmissionController:
     `observe` and `check` are called from concurrent handler threads."""
 
     def __init__(self, deadline_ms: float, recorder=None,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2,
+                 warming_capacity_frac: float = 0.5):
+        assert 0.0 <= warming_capacity_frac <= 1.0, warming_capacity_frac
         self.deadline_s = deadline_ms / 1000.0
         self.recorder = recorder
         self.ewma_alpha = ewma_alpha
+        self.warming_capacity_frac = warming_capacity_frac
         self.ewma_service_s: Optional[float] = None
         self.admitted_total = 0
         self.shed_total = 0
@@ -51,8 +54,15 @@ class AdmissionController:
                 service_s if prev is None else
                 self.ewma_alpha * service_s + (1.0 - self.ewma_alpha) * prev)
 
-    def check(self, depth: int, ready_replicas: int) -> Optional[int]:
+    def check(self, depth: int, ready_replicas: int,
+              warming_replicas: int = 0) -> Optional[int]:
         """Admit (None) or shed (int seconds for Retry-After).
+
+        Capacity counts live-but-warming replicas at
+        `warming_capacity_frac` (they will be serving within one warmup,
+        so mid-scale-out the prediction relaxes toward the NEW capacity
+        instead of shedding at the old estimate until the first replica
+        flips ready).
 
         Admits unconditionally while shedding is off (deadline <= 0), before
         the first observation (no basis for a prediction), or with no ready
@@ -62,7 +72,9 @@ class AdmissionController:
             if self.deadline_s <= 0 or ewma is None or ready_replicas <= 0:
                 self.admitted_total += 1
                 return None
-            predicted = depth * ewma / max(ready_replicas, 1)
+            capacity = (ready_replicas
+                        + self.warming_capacity_frac * max(warming_replicas, 0))
+            predicted = depth * ewma / max(capacity, 1e-9)
             if predicted <= self.deadline_s:
                 self.admitted_total += 1
                 return None
@@ -71,6 +83,7 @@ class AdmissionController:
         self._event(decision="shed", depth=depth,
                     predicted_wait_s=round(predicted, 6),
                     deadline_ms=self.deadline_s * 1000.0,
+                    warming_replicas=warming_replicas,
                     retry_after_s=retry_after)
         return retry_after
 
@@ -88,6 +101,7 @@ class AdmissionController:
                 "ewma_service_s": (round(self.ewma_service_s, 6)
                                    if self.ewma_service_s is not None
                                    else None),
+                "warming_capacity_frac": self.warming_capacity_frac,
                 "admitted_total": self.admitted_total,
                 "shed_total": self.shed_total,
             }

@@ -1,0 +1,81 @@
+"""CLI entry: python -m vitax.serve.fleet.agent — one placement agent per host.
+
+The remote half of cross-host placement (see placement.py): binds the
+agent API and waits. A fleet router provisions replicas here with
+`--placement_agents http://this-host:7070`, and every replica this agent
+spawns is supervised locally (restart-with-backoff, SIGTERM drain) via
+the agent's own ReplicaManager.
+
+    python -m vitax.serve.fleet.agent --agent_port 7070 \\
+        --agent_advertise 10.0.0.7 --agent_base_port 8100
+
+SIGTERM/SIGINT shut the API down, then SIGTERM-drain every replica the
+agent still owns (in-flight answered, exit 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from vitax.serve.fleet.placement import (PlacementAgent, start_agent,
+                                         stop_agent, DEFAULT_AGENT_PORT,
+                                         DEFAULT_BASE_PORT)
+
+
+def build_agent_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m vitax.serve.fleet.agent",
+        description="vitax placement agent: spawn/supervise serve replicas "
+                    "on this host for a remote fleet router")
+    parser.add_argument("--agent_port", type=int, default=DEFAULT_AGENT_PORT,
+                        help="port the agent API binds (0 = ephemeral)")
+    parser.add_argument("--agent_advertise", type=str, default="127.0.0.1",
+                        help="host embedded in provisioned replica URLs — "
+                             "the address the ROUTER can reach this host at")
+    parser.add_argument("--agent_base_port", type=int,
+                        default=DEFAULT_BASE_PORT,
+                        help="replica i spawned by this agent binds "
+                             "base_port + i (provision may pin an explicit "
+                             "port instead)")
+    parser.add_argument("--health_interval_s", type=float, default=0.5,
+                        help="seconds between the agent's replica /healthz "
+                             "sweeps")
+    parser.add_argument("--replica_max_restarts", type=int, default=10,
+                        help="restarts-with-backoff per replica before the "
+                             "agent gives up on it")
+    return parser
+
+
+def main(argv=None) -> int:
+    ns = build_agent_parser().parse_args(argv)
+    agent = PlacementAgent(
+        advertise_host=ns.agent_advertise, base_port=ns.agent_base_port,
+        health_interval_s=ns.health_interval_s,
+        max_restarts=ns.replica_max_restarts)
+    httpd = start_agent(agent, ns.agent_port)
+    print(f"placement agent: API on :{httpd.server_address[1]}, replicas "
+          f"from :{ns.agent_base_port} (advertised as {ns.agent_advertise})",
+          flush=True)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — handler signature
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded use)
+    while not stop.wait(timeout=0.5):
+        pass
+    print("placement agent: shutting down (replica drains)", flush=True)
+    stop_agent(httpd, agent)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

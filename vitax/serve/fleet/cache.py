@@ -1,0 +1,135 @@
+"""Content-addressed prediction cache: identical bytes never touch a TPU twice.
+
+Classification over an AOT-pinned engine is fully deterministic — the same
+preprocessed request bytes produce the same top-k every time (no sampling,
+no temperature, buckets compiled once at startup). That makes a router
+cache EXACT, not approximate: the cache key is the SHA-256 of the raw
+request body plus the requested topk, and a hit replays the first miss's
+200 response verbatim (byte-identical JSON). There is nothing to
+invalidate short of swapping the served weights, which restarts the fleet.
+
+Semantics:
+- **bounded LRU**: at most `max_entries` responses; inserting past the
+  bound evicts the least-recently-used entry. A hit refreshes recency.
+- **TTL**: entries older than `ttl_s` answer as misses and are dropped
+  (0 = no expiry). The TTL is a freshness valve for operators doing
+  in-place weight swaps behind the fleet, not a correctness need.
+- **hits bypass dispatch entirely**: the router answers a hit before
+  admission control, replica pick, or any network hop — a hit costs one
+  hash + one dict lookup and never counts against fleet capacity.
+
+Thread-safe (handler threads share one cache); `clock` is injectable so
+TTL expiry is testable without real time (tests/test_cache.py). Every hit
+emits a `kind:"cache"` telemetry event; misses are counted but only
+sampled into telemetry via snapshot() — at planet-scale request rates a
+per-miss event would dominate the JSONL.
+
+Stdlib-only: the router tier must run on a box with no jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+DEFAULT_TTL_S = 300.0
+
+
+class PredictionCache:
+    """Bounded LRU + TTL map: SHA-256(body) + topk -> verbatim 200 bytes."""
+
+    def __init__(self, max_entries: int, ttl_s: float = DEFAULT_TTL_S,
+                 recorder=None,
+                 clock: Callable[[], float] = time.monotonic):
+        assert max_entries >= 0, max_entries
+        assert ttl_s >= 0, ttl_s
+        self.max_entries = max_entries
+        self.ttl_s = ttl_s
+        self.recorder = recorder
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> (payload bytes, expiry clock time or 0.0 = never)
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self.hits_total = 0
+        self.misses_total = 0
+        self.evictions_total = 0
+        self.expirations_total = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_entries > 0
+
+    @staticmethod
+    def key(body: bytes, topk) -> str:
+        """Content address: the raw request bytes hash plus the requested
+        topk. Distinct topk values never alias — the same image at topk 1
+        and topk 5 are different responses."""
+        return f"{hashlib.sha256(body).hexdigest()}:{topk}"
+
+    def get(self, body: bytes, topk) -> Optional[bytes]:
+        """Cached 200 payload for this request, or None (miss/expired/off)."""
+        if not self.enabled:
+            return None
+        k = self.key(body, topk)
+        with self._lock:
+            entry = self._entries.get(k)
+            if entry is None:
+                self.misses_total += 1
+                return None
+            payload, expires = entry
+            if expires and self._clock() >= expires:
+                del self._entries[k]
+                self.expirations_total += 1
+                self.misses_total += 1
+                return None
+            self._entries.move_to_end(k)
+            self.hits_total += 1
+            hits, misses = self.hits_total, self.misses_total
+        # running totals ride along so tools/metrics_report.py can compute
+        # the hit rate from the JSONL alone (misses emit no events)
+        self._event(decision="hit", key=k[:16], bytes=len(payload),
+                    hits_total=hits, misses_total=misses)
+        return payload
+
+    def put(self, body: bytes, topk, payload: bytes) -> None:
+        """Store one 200 response verbatim, evicting LRU past the bound."""
+        if not self.enabled:
+            return
+        k = self.key(body, topk)
+        expires = (self._clock() + self.ttl_s) if self.ttl_s else 0.0
+        with self._lock:
+            self._entries[k] = (payload, expires)
+            self._entries.move_to_end(k)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions_total += 1
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            hits, misses = self.hits_total, self.misses_total
+            total = hits + misses
+            return {
+                "enabled": self.enabled,
+                "max_entries": self.max_entries,
+                "ttl_s": self.ttl_s,
+                "size": len(self._entries),
+                "hits_total": hits,
+                "misses_total": misses,
+                "hit_rate": (round(hits / total, 4) if total else None),
+                "evictions_total": self.evictions_total,
+                "expirations_total": self.expirations_total,
+            }
+
+    def _event(self, **payload) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.event("cache", **payload)
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] telemetry must not kill the hot path
+                pass

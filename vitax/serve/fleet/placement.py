@@ -1,0 +1,226 @@
+"""Cross-host placement: provision serve replicas on remote hosts over HTTP.
+
+Makes the router genuinely horizontal. One **placement agent** (python -m
+vitax.serve.fleet.agent) runs per serving host and wraps its own
+ReplicaManager, so a provisioned replica gets the exact lifecycle local
+fleet replicas get — spawn, health sweeps, restart with capped backoff,
+SIGTERM drain — through the same `vitax.supervise` seams (backoff_delay,
+terminate_child) the training supervisor uses. The router-side fleet
+adopts the returned URL: `adopt()` health-checks but never restarts,
+because the agent owns the lifecycle — exactly the adopt() contract.
+
+Agent endpoints:
+    GET  /healthz       liveness + replica count
+    GET  /replicas      per-replica manager snapshot
+    POST /provision     {"argv": [serve flags...], "name": ..., "port": 0}
+                        -> {"name", "url", "port"}  (port 0 = agent picks)
+    POST /release       {"name": ...} -> drain + terminate that replica
+
+The router-side **PlacementClient** is a thin urllib wrapper; the fleet
+CLI round-robins initial replicas and autoscaler scale-outs across
+`--placement_agents`, and the autoscaler's scale-in release path calls
+`release()` after the drain so remote processes never leak.
+
+The agent trusts its callers with an argv tail (it execs
+`python -m vitax.serve <argv> --serve_port N`), so it must only ever bind
+on infrastructure networks — same threat model as the chaos endpoint,
+minus the opt-in because there is no production fleet without placement.
+
+Both halves are stdlib-only and jax-free; the replicas an agent spawns
+are separate `python -m vitax.serve` processes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Sequence
+
+from vitax.serve.fleet.replica import ReplicaManager
+
+DEFAULT_AGENT_PORT = 7070
+DEFAULT_BASE_PORT = 8100
+DEFAULT_CLIENT_TIMEOUT_S = 30.0
+
+
+class PlacementAgent:
+    """Per-host replica factory over a private ReplicaManager."""
+
+    def __init__(self, advertise_host: str = "127.0.0.1",
+                 base_port: int = DEFAULT_BASE_PORT,
+                 manager: Optional[ReplicaManager] = None,
+                 recorder=None, **manager_kw):
+        self.advertise_host = advertise_host
+        self.base_port = base_port
+        self.manager = manager if manager is not None else ReplicaManager(
+            recorder=recorder, **manager_kw)
+        self.recorder = recorder
+        self.provisions_total = 0
+        self.releases_total = 0
+        self._next_port = 0
+        self._lock = threading.Lock()
+
+    def provision(self, argv: Sequence[str], name: Optional[str] = None,
+                  port: int = 0) -> dict:
+        """Spawn one `python -m vitax.serve` replica on this host; the
+        manager owns it from here (health, restart-with-backoff, drain)."""
+        if not isinstance(argv, (list, tuple)) or not all(
+                isinstance(a, str) for a in argv):
+            raise ValueError("argv must be a list of strings")
+        with self._lock:
+            if port == 0:
+                port = self.base_port + self._next_port
+                self._next_port += 1
+            count = self.provisions_total
+            self.provisions_total += 1
+        name = name or f"agent_replica_{count}"
+        if self.manager.find(name) is not None:
+            raise ValueError(f"replica {name!r} already exists on this agent")
+        url = f"http://{self.advertise_host}:{port}"
+        full_argv = ([sys.executable, "-m", "vitax.serve"] + list(argv)
+                     + ["--serve_port", str(port)])
+        self.manager.manage(full_argv, url, name=name)
+        self._event(event="provision", replica=name, port=port, url=url)
+        return {"name": name, "url": url, "port": port}
+
+    def release(self, name: str) -> bool:
+        """Retire + drain + terminate one replica; False if unknown."""
+        replica = self.manager.find(name)
+        if replica is None:
+            return False
+        self.manager.retire(replica)
+        self.manager.discard(replica)   # terminate_child SIGTERM-drains
+        with self._lock:
+            self.releases_total += 1
+        self._event(event="release", replica=name)
+        return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"provisions_total": self.provisions_total,
+                   "releases_total": self.releases_total}
+        out["replicas"] = self.manager.snapshot()
+        return out
+
+    def _event(self, **payload) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.event("placement", **payload)
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] telemetry must not kill placement
+                pass
+
+
+def _make_handler(agent: PlacementAgent):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: A003
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "ok",
+                    "replicas": len(agent.manager.snapshot()),
+                    "ready": agent.manager.ready_count()})
+            elif self.path == "/replicas":
+                self._reply(200, agent.snapshot())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError as e:
+                self._reply(400, {"error": f"bad JSON body: {e}"})
+                return
+            if self.path == "/provision":
+                try:
+                    out = agent.provision(payload.get("argv", []),
+                                          name=payload.get("name"),
+                                          port=int(payload.get("port", 0)))
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                self._reply(200, out)
+            elif self.path == "/release":
+                name = payload.get("name", "")
+                if agent.release(name):
+                    self._reply(200, {"released": name})
+                else:
+                    self._reply(404, {"error": f"unknown replica {name!r}"})
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+    return Handler
+
+
+def start_agent(agent: PlacementAgent, port: int = DEFAULT_AGENT_PORT):
+    """Bind the agent API (background thread) and start the manager's
+    health loop. Returns the httpd; server_address[1] is the bound port."""
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), _make_handler(agent))
+    httpd.daemon_threads = True
+    thread = threading.Thread(  # vtx: ignore[VTX205] stop_agent's httpd.shutdown() ends serve_forever
+        target=httpd.serve_forever, daemon=True, name="vitax-placement-agent")
+    thread.start()
+    agent.manager.start()
+    return httpd
+
+
+def stop_agent(httpd, agent: PlacementAgent) -> None:
+    """Stop the API, then SIGTERM-drain every replica this agent owns."""
+    httpd.shutdown()
+    httpd.server_close()
+    agent.manager.stop()
+
+
+class PlacementClient:
+    """Router-side handle on one agent. Injectable transport for tests."""
+
+    def __init__(self, agent_url: str,
+                 timeout_s: float = DEFAULT_CLIENT_TIMEOUT_S,
+                 http_json=None):
+        self.agent_url = agent_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._http_json = http_json or self._default_http_json
+
+    @staticmethod
+    def _default_http_json(url: str, payload: Optional[dict],
+                           timeout: float) -> dict:
+        data = (json.dumps(payload).encode("utf-8")
+                if payload is not None else None)
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"} if data else {})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.load(resp)
+
+    def healthz(self) -> dict:
+        return self._http_json(self.agent_url + "/healthz", None,
+                               self.timeout_s)
+
+    def replicas(self) -> dict:
+        return self._http_json(self.agent_url + "/replicas", None,
+                               self.timeout_s)
+
+    def provision(self, argv: List[str], name: Optional[str] = None,
+                  port: int = 0) -> dict:
+        """{"name", "url", "port"} of a freshly spawned remote replica —
+        adopt() the url into the local fleet to route to it."""
+        return self._http_json(
+            self.agent_url + "/provision",
+            {"argv": list(argv), "name": name, "port": port}, self.timeout_s)
+
+    def release(self, name: str) -> dict:
+        return self._http_json(self.agent_url + "/release", {"name": name},
+                               self.timeout_s)

@@ -78,6 +78,7 @@ class Replica:
         self.argv = list(argv) if argv is not None else None
         self.proc = proc                 # None for adopted replicas
         self.state = STARTING
+        self.retired = False             # scale-in: out of rotation for good
         self.in_flight = 0
         self.ewma_latency_s: Optional[float] = None
         self.requests_total = 0
@@ -96,6 +97,7 @@ class Replica:
         return {
             "url": self.url,
             "state": self.state,
+            "retired": self.retired,
             "managed": self.managed,
             "in_flight": self.in_flight,
             "ewma_latency_s": (round(self.ewma_latency_s, 6)
@@ -143,6 +145,7 @@ class ReplicaManager:
         self.health_jitter = health_jitter
         self._rng = rng or random.Random()
         self.replicas: List[Replica] = []
+        self._name_seq = 0               # monotonic: discard never recycles names
         self.restart_total = 0
         self.started = time.time()
         self._lock = threading.Lock()
@@ -160,7 +163,8 @@ class ReplicaManager:
         """Spawn a replica subprocess and own its lifecycle (restart on
         death, SIGTERM drain on stop)."""
         with self._lock:
-            name = name or f"replica_{len(self.replicas)}"
+            name = name or f"replica_{self._name_seq}"
+            self._name_seq += 1
         replica = Replica(name, url, argv=argv, proc=self._spawn(list(argv)))
         with self._lock:
             self.replicas.append(replica)
@@ -171,7 +175,8 @@ class ReplicaManager:
         """Register an externally started replica: health-checked and
         rotated, never restarted (its lifecycle belongs to someone else)."""
         with self._lock:
-            name = name or f"replica_{len(self.replicas)}"
+            name = name or f"replica_{self._name_seq}"
+            self._name_seq += 1
         replica = Replica(name, url)
         with self._lock:
             self.replicas.append(replica)
@@ -182,14 +187,43 @@ class ReplicaManager:
 
     def ready_replicas(self) -> List[Replica]:
         with self._lock:
-            return [r for r in self.replicas if r.state == READY]
+            return [r for r in self.replicas
+                    if r.state == READY and not r.retired]
 
     def ready_count(self) -> int:
         return len(self.ready_replicas())
 
+    def warming_count(self) -> int:
+        """Live-but-warming replicas: spawned/adopted/restarted, not yet
+        admitting traffic (STARTING until their own /healthz turns ready).
+        Admission control counts these at --warming_capacity_frac so an
+        in-progress scale-out relieves the predicted wait instead of the
+        fleet shedding at the old capacity estimate."""
+        with self._lock:
+            return sum(1 for r in self.replicas
+                       if r.state == STARTING and not r.retired)
+
+    def active_count(self) -> int:
+        """Fleet size for scaling decisions: every replica not retired
+        (STARTING/READY/EJECTED/DEAD-awaiting-restart all count — they are
+        capacity the fleet still owns or will recover)."""
+        with self._lock:
+            return sum(1 for r in self.replicas if not r.retired)
+
     def total_in_flight(self) -> int:
         with self._lock:
             return sum(r.in_flight for r in self.replicas)
+
+    def in_flight_of(self, replica: Replica) -> int:
+        with self._lock:
+            return replica.in_flight
+
+    def find(self, name: str) -> Optional[Replica]:
+        with self._lock:
+            for r in self.replicas:
+                if r.name == name:
+                    return r
+            return None
 
     def degraded_count(self) -> int:
         """Replicas whose last /healthz advertised brownout (degraded:
@@ -213,7 +247,8 @@ class ReplicaManager:
         count — pair every acquire with a release()."""
         with self._lock:
             candidates = [r for r in self.replicas
-                          if r.state == READY and r.name not in exclude]
+                          if r.state == READY and not r.retired
+                          and r.name not in exclude]
             if not candidates:
                 return None
             best = min(candidates,
@@ -242,14 +277,51 @@ class ReplicaManager:
             else:
                 replica.dispatch_failures += 1
 
+    # -- scale-in lifecycle ----------------------------------------------------
+
+    def retire(self, replica: Replica) -> None:
+        """Take a replica out of rotation for good (scale-in step 1): no
+        new dispatches, and the health loop will never re-admit it. Its
+        in-flight requests keep draining — pair with discard() once
+        in_flight reaches zero."""
+        with self._lock:
+            if replica.retired:
+                return
+            replica.retired = True
+            if replica.state == READY:
+                replica.state = EJECTED
+        self._event("replica_retire", replica=replica.name)
+
+    def discard(self, replica: Replica) -> Optional[int]:
+        """Remove a replica from the fleet (scale-in step 2). A managed
+        process still alive is SIGTERM-drained through terminate_child —
+        the replica's own drain contract answers anything left in flight
+        before it exits. Returns the exit code (None for adopted
+        replicas, whose processes belong to someone else)."""
+        rc = None
+        if replica.proc is not None and replica.proc.poll() is None:
+            rc = terminate_child(replica.proc, self.term_grace_s,
+                                 sleep=self._sleep)
+        with self._lock:
+            replica.state = DEAD
+            replica.retired = True
+            if rc is not None:
+                replica.exit_code = rc
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+        self._event("replica_discard", replica=replica.name, exit_code=rc)
+        return rc
+
     # -- health loop ----------------------------------------------------------
 
     def poll_once(self, now: Optional[float] = None) -> None:
         """One health sweep over the fleet (the background loop calls this
-        every health_interval_s; tests call it directly)."""
+        every health_interval_s; tests call it directly). Retired replicas
+        are skipped: they are draining toward discard() and must never be
+        re-admitted or respawned."""
         now = self._clock() if now is None else now
         with self._lock:  # manage()/adopt() append concurrently
-            fleet = list(self.replicas)
+            fleet = [r for r in self.replicas if not r.retired]
         for replica in fleet:
             self._poll_replica(replica, now)
 

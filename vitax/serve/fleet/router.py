@@ -43,6 +43,7 @@ Stdlib-only and jax-free: the router runs on a box with no accelerator.
 
 from __future__ import annotations
 
+import base64
 import json
 import queue as queue_mod
 import threading
@@ -51,7 +52,7 @@ import urllib.error
 import urllib.request
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from vitax import faults
 from vitax.serve.fleet.admission import AdmissionController
@@ -59,6 +60,7 @@ from vitax.serve.fleet.breaker import (CircuitBreaker, RetryBudget,
                                        DEFAULT_BUDGET_RATIO,
                                        DEFAULT_COOLDOWN_S,
                                        DEFAULT_FAIL_THRESHOLD)
+from vitax.serve.fleet.cache import PredictionCache
 from vitax.serve.fleet.replica import ReplicaManager
 
 DISPATCH_ATTEMPTS = 2  # first pick + one retry on a different replica
@@ -86,6 +88,7 @@ class RouterMetrics:
         self.retries_total = 0
         self.hedges_total = 0
         self.hedge_wins_total = 0
+        self.cache_hits_total = 0
         self._latency = deque(maxlen=window)
         self._times = deque(maxlen=window)
 
@@ -115,6 +118,13 @@ class RouterMetrics:
         with self._lock:
             self.hedge_wins_total += 1
 
+    def cache_hit(self) -> None:
+        """A /predict answered from the prediction cache: no dispatch, no
+        latency sample — counted apart so requests_per_sec stays a measure
+        of replica work."""
+        with self._lock:
+            self.cache_hits_total += 1
+
     def p99(self) -> Optional[float]:
         """Rolling client-latency p99 — the hedge trigger threshold."""
         with self._lock:
@@ -128,6 +138,7 @@ class RouterMetrics:
             total, errors = self.requests_total, self.errors_total
             shed, retries = self.shed_total, self.retries_total
             hedges, hedge_wins = self.hedges_total, self.hedge_wins_total
+            cache_hits = self.cache_hits_total
         now = time.time()
         recent = [t for t in times if now - t <= 60.0]
         return {
@@ -137,6 +148,7 @@ class RouterMetrics:
             "retries_total": retries,
             "hedges_total": hedges,
             "hedge_wins_total": hedge_wins,
+            "cache_hits_total": cache_hits,
             "uptime_s": round(now - self.started, 3),
             "requests_per_sec": round(total / max(now - self.started, 1e-9), 3),
             "requests_per_sec_60s": round(len(recent) / 60.0, 3),
@@ -156,8 +168,12 @@ class Router:
                  breaker_threshold: int = DEFAULT_FAIL_THRESHOLD,
                  breaker_cooldown_s: float = DEFAULT_COOLDOWN_S,
                  retry_budget_ratio: float = DEFAULT_BUDGET_RATIO,
-                 hedge_after_ms: float = 0.0):
+                 hedge_after_ms: float = 0.0,
+                 cache: Optional[PredictionCache] = None,
+                 autoscaler=None,
+                 batch_window_ms: float = 0.0, batch_max: int = 8):
         assert hedge_after_ms >= 0, hedge_after_ms
+        assert batch_window_ms >= 0, batch_window_ms
         self.manager = manager
         self.admission = admission
         self.recorder = recorder
@@ -165,10 +181,18 @@ class Router:
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
         self.hedge_after_ms = hedge_after_ms
+        self.cache = cache
+        self.autoscaler = autoscaler  # observability only; it owns itself
         self.budget = RetryBudget(ratio=retry_budget_ratio)
         self.metrics = RouterMetrics()
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._breaker_lock = threading.Lock()
+        # cross-replica continuous batching (opt-in, --batch_window_ms):
+        # the composer groups concurrent /predict bodies and dispatches one
+        # /predict_batch per group instead of trickling singles into every
+        # replica's own max_batch_wait_ms window
+        self._composer = (BatchComposer(self, batch_window_ms, batch_max)
+                          if batch_window_ms > 0 else None)
 
     # -- dispatch --------------------------------------------------------------
 
@@ -176,7 +200,19 @@ class Router:
                  content_type: str) -> Tuple[int, dict, object]:
         """Route one /predict. Returns (status, extra headers, payload):
         payload is raw bytes on 200 (the replica's JSON passed through
-        verbatim) and a dict (to be JSON-encoded) otherwise."""
+        verbatim) and a dict (to be JSON-encoded) otherwise.
+
+        Order matters: the cache is consulted FIRST — a hit is exact
+        (deterministic AOT-pinned classification) and free, so it bypasses
+        readiness, admission, and dispatch entirely; identical bytes never
+        touch a TPU twice, and cached answers keep flowing even while the
+        fleet has zero ready replicas."""
+        topk = self._request_topk(body, content_type)
+        if self.cache is not None:
+            hit = self.cache.get(body, topk)
+            if hit is not None:
+                self.metrics.cache_hit()
+                return 200, {"X-Vitax-Cache": "hit"}, hit
         ready = self.manager.ready_count()
         if ready == 0:
             self.metrics.error()
@@ -184,13 +220,47 @@ class Router:
                 "error": "no ready replicas", "reason": "no_ready_replicas"}
         if self.admission is not None:
             retry_after = self.admission.check(
-                self.manager.total_in_flight(), ready)
+                self.manager.total_in_flight(), ready,
+                warming_replicas=self.manager.warming_count())
             if retry_after is not None:
                 self.metrics.shed()
                 return 429, {"Retry-After": str(retry_after)}, {
                     "error": "shed: predicted wait exceeds the p99 deadline",
                     "reason": "admission"}
         self.budget.deposit()
+        if self._composer is not None:
+            status, headers, payload = self._composer.submit(
+                body, content_type)
+        else:
+            status, headers, payload = self._dispatch_direct(
+                body, content_type)
+        if (status == 200 and self.cache is not None
+                and isinstance(payload, bytes)
+                and self.manager.degraded_count() == 0):
+            # never cache a browned-out answer: degraded replicas clamp
+            # topk to 1, and replaying that after recovery would be wrong
+            self.cache.put(body, topk, payload)
+        return status, headers, payload
+
+    @staticmethod
+    def _request_topk(body: bytes, content_type: str):
+        """The topk component of the cache key. JSON bodies may carry a
+        per-request topk; raw image bodies get the replica default. (The
+        body hash already separates the two — this keeps the key honest
+        and the `distinct topk never alias` property self-evident.)"""
+        if content_type and "application/json" in content_type:
+            try:
+                topk = json.loads(body.decode("utf-8")).get("topk")
+                if topk is not None:
+                    return int(topk)
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] a malformed body keys as default; the replica 400s it
+                pass
+        return "default"
+
+    def _dispatch_direct(self, body: bytes,
+                         content_type: str) -> Tuple[int, dict, object]:
+        """The per-request attempt loop: least-loaded pick, one retry on a
+        different replica, hedging, breaker + retry-budget containment."""
         exclude: set = set()
         for attempt in range(DISPATCH_ATTEMPTS):
             replica = self._pick(exclude)
@@ -389,6 +459,86 @@ class Router:
             self.metrics.error()
         return status, outcome["headers"], outcome["payload"]
 
+    def _attempt_batch(self, items: List[dict]):
+        """One /predict_batch dispatch carrying a composed group to one
+        replica. Returns a list of per-item (status, headers, payload)
+        tuples aligned with `items`, the sentinel string "unsupported"
+        when the replica has no /predict_batch (404/501 — mixed-version
+        fleet), or None on a dispatch failure (the composer falls back to
+        per-item direct dispatch either way)."""
+        replica = self._pick(set())
+        if replica is None:
+            return None
+        breaker = self._breaker(replica.name)
+        wire = json.dumps({
+            "items": [base64.b64encode(it["body"]).decode("ascii")
+                      for it in items],
+            "content_types": [it["content_type"] or
+                              "application/octet-stream" for it in items],
+        }).encode("utf-8")
+        t0 = time.monotonic()
+        try:
+            faults.fire("router_dispatch")
+            req = urllib.request.Request(
+                replica.url + "/predict_batch", data=wire,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                out = json.load(resp)
+            latency = time.monotonic() - t0
+            results = out.get("results")
+            if (not isinstance(results, list)
+                    or len(results) != len(items)):
+                # answered but malformed: count the dispatch against the
+                # breaker and let the composer re-drive items directly
+                self.manager.release(replica, ok=False)
+                breaker.record_failure()
+                return None
+            self.manager.release(replica, latency_s=latency, ok=True)
+            breaker.record_success()
+            if self.admission is not None:
+                # one EWMA sample per batch, not per item: the predictor
+                # models dispatch round-trips, and a group is one trip
+                self.admission.observe(latency)
+            outcomes = []
+            for res in results:
+                status = int(res.get("status", 500))
+                payload = str(res.get("body", "")).encode("utf-8")
+                if status == 200:
+                    self.metrics.observe(latency)
+                    outcomes.append((200, {}, payload))
+                elif status == 503 and res.get("reason") == "queue_full":
+                    self.metrics.shed()
+                    if self.admission is not None:
+                        self.admission.record_shed(
+                            reason="replica_queue_full",
+                            replica=replica.name)
+                    outcomes.append((429, {"Retry-After": "1"}, {
+                        "error": "shed: replica queue full",
+                        "reason": "replica_queue_full"}))
+                else:
+                    # client errors (bad image, bad topk) pass through
+                    # verbatim, exactly like the single-dispatch path
+                    self.metrics.error()
+                    outcomes.append((status, {}, payload or {
+                        "error": f"replica answered {status}"}))
+            return outcomes
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 501):
+                # no /predict_batch on this replica: not a fault, just an
+                # older binary — hand the slot back uncharged
+                self.manager.release(replica, counted=False)
+                breaker.record_success()
+                return "unsupported"
+            detail = f"HTTP {e.code}"
+        except Exception as e:  # noqa: BLE001 — refused/timeout/reset
+            detail = f"{type(e).__name__}: {e}"
+        self.manager.release(replica, ok=False)
+        breaker.record_failure()
+        self._event("dispatch_retry", replica=replica.name,
+                    attempt="batch", detail=detail)
+        return None
+
     @staticmethod
     def _json_body(e: urllib.error.HTTPError) -> dict:
         try:
@@ -423,6 +573,9 @@ class Router:
         snap["fleet"] = {
             "size": len(replicas),
             "ready": self.manager.ready_count(),
+            # scale-out visibility: live replicas still inside warmup —
+            # admission already counts them at warming_capacity_frac
+            "warming": self.manager.warming_count(),
             "in_flight": self.manager.total_in_flight(),
             "replica_restarts": self.manager.restart_total,
             # brownout visibility: replicas advertising degraded: true in
@@ -458,7 +611,23 @@ class Router:
         snap["retry_budget"] = self.budget.snapshot()
         if self.admission is not None:
             snap["admission"] = self.admission.snapshot()
+        if self.cache is not None:
+            snap["cache"] = self.cache.snapshot()
+            snap["cache_hits"] = snap["cache"]["hits_total"]
+            snap["cache_hit_rate"] = snap["cache"]["hit_rate"]
+        if self.autoscaler is not None:
+            snap["autoscale"] = self.autoscaler.snapshot()
+            snap["scale_events"] = (snap["autoscale"]["scale_out_total"]
+                                    + snap["autoscale"]["scale_in_total"])
+        if self._composer is not None:
+            snap["continuous_batching"] = self._composer.snapshot()
         return snap
+
+    def close(self) -> None:
+        """Stop router-owned background machinery (the batch composer);
+        the manager and autoscaler have their own stop() lifecycles."""
+        if self._composer is not None:
+            self._composer.close()
 
     def _event(self, kind: str, **payload) -> None:
         if self.recorder is not None:
@@ -466,6 +635,164 @@ class Router:
                 self.recorder.event(kind, **payload)
             except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] telemetry must not kill dispatch
                 pass
+
+
+class BatchComposer:
+    """Cross-replica continuous batching, Orca-style at the fleet level.
+
+    Without it, each replica's DynamicBatcher waits out its own
+    --max_batch_wait_ms hoping for co-arrivals, but least-loaded routing
+    SPREADS concurrent arrivals across replicas — so at moderate load
+    every replica batcher times out at batch_size 1 and the TPU runs its
+    AOT bucket at 1/max_batch occupancy. The composer inverts that:
+    concurrent /predict bodies wait up to `window_ms` at the ROUTER, then
+    one /predict_batch carries the whole group to ONE replica, whose
+    batcher admits them together into a single bucket.
+
+    Exactness: the replica answers each item with the byte-identical JSON
+    body a lone /predict would have produced (same engine, same padded
+    bucket semantics), so clients cannot tell composed from direct
+    dispatch.
+
+    Fallbacks: a replica without /predict_batch (404/501 — mixed-version
+    fleet) disables composition permanently for this router; a dispatch
+    failure re-drives just that group. Both paths settle every item via
+    _dispatch_direct, so composition never costs availability.
+
+    Threading: one worker groups under a Condition (wait-in-while);
+    handler threads block on a per-item Event. close() joins the worker
+    and 503s anything still parked.
+    """
+
+    def __init__(self, router: Router, window_ms: float, batch_max: int):
+        assert window_ms > 0, window_ms
+        assert batch_max >= 1, batch_max
+        self.router = router
+        self.window_s = window_ms / 1000.0
+        self.batch_max = batch_max
+        self._cond = threading.Condition()
+        # guarded by _cond:
+        self._pending: List[dict] = []
+        self._closed = False
+        self._disabled = False
+        self.batches_total = 0
+        self.items_total = 0
+        self.fallback_items_total = 0
+        self._fills = deque(maxlen=4096)
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="vitax-batch-composer")
+        self._worker.start()
+
+    def submit(self, body: bytes,
+               content_type: str) -> Tuple[int, dict, object]:
+        """Handler-thread entry: park this request for grouping and block
+        until its group's dispatch settles it."""
+        item = {"body": body, "content_type": content_type,
+                "done": threading.Event(), "result": None}
+        with self._cond:
+            bypass = self._disabled or self._closed
+            if not bypass:
+                self._pending.append(item)
+                self._cond.notify()
+        if bypass:
+            # composition is off (mixed-version fleet) or shutting down:
+            # same answer, just without the grouping wait
+            return self.router._dispatch_direct(body, content_type)
+        timeout = self.window_s + self.router.request_timeout_s + 5.0
+        if not item["done"].wait(timeout=timeout):
+            self.router.metrics.error()
+            return 503, {"Retry-After": "1"}, {
+                "error": "batched dispatch timed out",
+                "reason": "dispatch_failed"}
+        return item["result"]
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return  # close() settles whatever is still parked
+                # the window opens at the FIRST arrival: collect
+                # co-arrivals until it closes or the group is full
+                deadline = time.monotonic() + self.window_s
+                while len(self._pending) < self.batch_max \
+                        and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                group = self._pending[:self.batch_max]
+                del self._pending[:len(group)]
+                self.batches_total += 1
+                self.items_total += len(group)
+                self._fills.append(len(group) / self.batch_max)
+            self._dispatch_group(group)  # outside the lock: it blocks
+
+    def _dispatch_group(self, group: List[dict]) -> None:
+        with self._cond:
+            disabled = self._disabled
+        outcomes = None if disabled else self.router._attempt_batch(group)
+        if outcomes == "unsupported":
+            with self._cond:
+                self._disabled = True
+            self.router._event("continuous_batching", event="disabled",
+                               detail="replica lacks /predict_batch")
+            outcomes = None
+        if outcomes is None:
+            self._fallback(group)
+            return
+        for item, outcome in zip(group, outcomes):
+            item["result"] = outcome
+            item["done"].set()
+
+    def _fallback(self, group: List[dict]) -> None:
+        """Settle every item of a failed group via the direct per-request
+        path (which has its own retry/breaker/budget containment)."""
+        with self._cond:
+            self.fallback_items_total += len(group)
+
+        def run(item: dict) -> None:
+            item["result"] = self.router._dispatch_direct(
+                item["body"], item["content_type"])
+            item["done"].set()
+
+        threads = [threading.Thread(target=run, args=(it,), daemon=True,
+                                    name="vitax-batch-fallback")
+                   for it in group]
+        for t in threads:
+            t.start()
+        for t in threads:
+            # a straggler past this join still settles its own item, and
+            # submit()'s wait timeout bounds the client either way
+            t.join(timeout=self.router.request_timeout_s + 5.0)
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            fills = sorted(self._fills)
+            return {
+                "window_ms": round(self.window_s * 1000.0, 3),
+                "batch_max": self.batch_max,
+                "disabled": self._disabled,
+                "batches_total": self.batches_total,
+                "items_total": self.items_total,
+                "fallback_items_total": self.fallback_items_total,
+                "batch_fill_p50": _percentile(fills, 0.50),
+                "batch_fill_p95": _percentile(fills, 0.95),
+            }
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=10.0)
+        with self._cond:
+            leftovers, self._pending = self._pending, []
+        for item in leftovers:
+            item["result"] = (503, {"Retry-After": "1"}, {
+                "error": "router shutting down",
+                "reason": "dispatch_failed"})
+            item["done"].set()
 
 
 def _make_handler(router: Router):
@@ -516,6 +843,8 @@ def start_router(router: Router, port: int):
     return httpd
 
 
-def stop_router(httpd) -> None:
+def stop_router(httpd, router: Optional[Router] = None) -> None:
     httpd.shutdown()
     httpd.server_close()
+    if router is not None:
+        router.close()

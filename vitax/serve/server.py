@@ -464,7 +464,7 @@ def _make_handler(ctx: ServeContext):
             if self.path == "/chaos":
                 self._chaos()
                 return
-            if self.path != "/predict":
+            if self.path not in ("/predict", "/predict_batch"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             if not ctx.enter_request():
@@ -475,7 +475,10 @@ def _make_handler(ctx: ServeContext):
                             headers={"Retry-After": "1"})
                 return
             try:
-                self._predict()
+                if self.path == "/predict_batch":
+                    self._predict_batch()
+                else:
+                    self._predict()
             finally:
                 ctx.exit_request()
 
@@ -556,6 +559,77 @@ def _make_handler(ctx: ServeContext):
                 "probs": [float(p) for p in result.probs[:topk]],
                 "latency_ms": round(latency_s * 1000.0, 3),
             })
+
+        def _predict_batch(self) -> None:
+            """Composed dispatch from the fleet router (BatchComposer in
+            vitax/serve/fleet/router.py): decode every item, submit ALL
+            of them to the batcher BEFORE waiting on any future — the
+            group lands in the queue together, so the DynamicBatcher
+            flushes it as one bucket instead of trickling singles through
+            its max_batch_wait_ms window. Each item's `body` is the exact
+            JSON a lone /predict would have produced (same engine, same
+            formatting), so composed and direct dispatch are
+            indistinguishable to clients. Per-item failures (bad image,
+            queue full, inference error) settle that item only; the
+            batch call itself only 400s on an unparseable envelope."""
+            t0 = time.time()
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                wire = json.loads(self.rfile.read(length).decode("utf-8"))
+                bodies = [base64.b64decode(s) for s in wire["items"]]
+                ctypes = wire.get("content_types") or [""] * len(bodies)
+                if len(ctypes) != len(bodies):
+                    raise ValueError("content_types/items length mismatch")
+            except Exception as e:  # noqa: BLE001 — client error, not ours
+                ctx.metrics.error()
+                self._reply(400, {"error": f"bad batch request: {e}"})
+                return
+            results = [None] * len(bodies)
+            waiting = []  # (index, topk, future)
+            for i, (body, ctype) in enumerate(zip(bodies, ctypes)):
+                try:
+                    image, topk = ctx.decode(body, ctype)
+                except Exception as e:  # noqa: BLE001 — client error
+                    ctx.metrics.error()
+                    results[i] = {"status": 400, "body": json.dumps(
+                        {"error": f"bad request: {e}"})}
+                    continue
+                if ctx.degraded():
+                    topk = 1
+                try:
+                    fut = ctx.batcher.submit(image)
+                except QueueFull as e:
+                    ctx.metrics.error()
+                    results[i] = {"status": 503, "reason": "queue_full",
+                                  "body": json.dumps(
+                                      {"error": f"overloaded: {e}",
+                                       "reason": "queue_full"})}
+                    continue
+                waiting.append((i, topk, fut))
+            for i, topk, fut in waiting:
+                try:
+                    result = fut.result(timeout=ctx.request_timeout_s)
+                except Exception as e:  # noqa: BLE001
+                    ctx.metrics.error()
+                    results[i] = {"status": 503, "body": json.dumps(
+                        {"error": f"inference failed: {e}"})}
+                    continue
+                latency_s = time.time() - t0
+                ctx.metrics.observe(latency_s, result.queue_wait_s,
+                                    result.batch_size, result.bucket)
+                if ctx.recorder is not None:
+                    ctx.recorder.event(
+                        "serve_request", latency_s=round(latency_s, 6),
+                        queue_wait_s=round(result.queue_wait_s, 6),
+                        infer_s=round(result.infer_s, 6),
+                        batch_size=result.batch_size, bucket=result.bucket,
+                        topk=topk, batched=True)
+                results[i] = {"status": 200, "body": json.dumps({
+                    "classes": [int(c) for c in result.classes[:topk]],
+                    "probs": [float(p) for p in result.probs[:topk]],
+                    "latency_ms": round(latency_s * 1000.0, 3),
+                })}
+            self._reply(200, {"results": results})
 
     return Handler
 
