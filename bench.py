@@ -41,6 +41,28 @@ BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 from vitax.telemetry.flops import (  # noqa: E402
     PEAK_TFLOPS, detect_peak_tflops, model_flops_per_image)
 
+# The perf-knob surface (argparse group / resolved payload) is shared with
+# tools/profile_step.py, tools/aot_topology.py and tools/autotune.py —
+# stdlib-only imports, safe before backend selection.
+from vitax.tune.knobs import (  # noqa: E402
+    add_knob_args, knob_payload, knobs_from_args)
+
+
+def apply_preset_file(args, n_dev: int) -> None:
+    """--preset_file: fill every knob still at its sentinel default from a
+    committed autotune preset (presets/<model>_<topology>.json). Explicit
+    CLI flags win; the preset's RESOLVED knobs pin everything else, so the
+    run reproduces the winning knob set exactly (TUNED.json defaults cannot
+    leak underneath). Needs the live device count: batch is stored per-chip."""
+    if not getattr(args, "preset_file", ""):
+        return
+    from vitax.tune.preset import apply_preset_to_args, load_preset
+    preset = load_preset(args.preset_file)
+    applied = apply_preset_to_args(preset, args, n_dev)
+    print(f"bench: preset {args.preset_file} "
+          f"({preset['model_preset']}@{preset['topology']}) applied "
+          f"{applied}", file=sys.stderr, flush=True)
+
 _emitted = threading.Lock()
 
 # --metrics_dir: also append the emitted payload to <dir>/bench.jsonl
@@ -657,16 +679,14 @@ def bench_e2e(args, metric_stub: str) -> None:
     from vitax.train.step import make_train_step
 
     train_preset = args.e2e_train_preset
-    kw = train_presets(n_dev)[train_preset]
-    if args.batch_size:
-        kw["batch_size"] = args.batch_size
-    if args.fused_optimizer != "auto":
-        kw["fused_optimizer"] = args.fused_optimizer
+    apply_preset_file(args, n_dev)
+    kn = knobs_from_args(args)
+    kw = kn.apply_to_preset_kw(train_presets(n_dev)[train_preset])
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
         args.remat_policy, train_preset,
-        other_explicit=bool(args.batch_size))
+        other_explicit=kn.other_explicit())
     cfg = Config(num_classes=1000, warmup_steps=0,
                  remat_policy=args.remat_policy, grad_ckpt=args.grad_ckpt,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,
@@ -771,6 +791,9 @@ def bench_e2e(args, metric_stub: str) -> None:
         "vs_baseline": vs,
         "mfu": round(e2e_mfu, 4),
         "peak_tflops_per_chip": peak,
+        # same resolved-knob contract as bench_train: an e2e number must
+        # also say what it ran (historically this payload had no knobs)
+        "knobs": knob_payload(cfg, n_dev),
     })
 
 
@@ -791,36 +814,14 @@ def bench_train(args, metric_stub: str) -> None:
     from vitax.train.step import make_train_step
     from jax.sharding import NamedSharding
 
-    kw = train_presets(n_dev)[args.preset]
-    if args.batch_size:
-        kw["batch_size"] = args.batch_size
-    if args.moe_impl:
-        kw["moe_impl"] = args.moe_impl
-    if args.att_dropout is not None:
-        kw["att_dropout"] = args.att_dropout
-    if args.grad_accum_steps > 1:
-        kw["grad_accum_steps"] = args.grad_accum_steps
-    if args.param_gather_dtype:
-        kw["param_gather_dtype"] = args.param_gather_dtype
-    if args.grad_reduce_dtype != "float32":
-        kw["grad_reduce_dtype"] = args.grad_reduce_dtype
-    if args.gather_overlap != "auto":
-        kw["gather_overlap"] = args.gather_overlap
-    if args.fused_optimizer != "auto":
-        kw["fused_optimizer"] = args.fused_optimizer
+    apply_preset_file(args, n_dev)
+    kn = knobs_from_args(args)
+    kw = kn.apply_to_preset_kw(train_presets(n_dev)[args.preset])
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
         args.remat_policy, args.preset,
-        other_explicit=(not args.grad_ckpt or not args.use_flash_attention
-                        or bool(args.batch_size)
-                        or args.moe_impl is not None
-                        or args.att_dropout is not None
-                        or args.grad_accum_steps > 1
-                        or args.param_gather_dtype is not None
-                        or args.grad_reduce_dtype != "float32"
-                        or args.gather_overlap != "auto"
-                        or args.fused_optimizer != "auto"))
+        other_explicit=kn.other_explicit())
     cfg = Config(num_classes=1000, warmup_steps=0, remat_policy=args.remat_policy,
                  grad_ckpt=args.grad_ckpt, scan_blocks=args.scan_blocks,
                  scan_unroll=args.scan_unroll, remat_window=args.remat_window,
@@ -944,20 +945,12 @@ def bench_train(args, metric_stub: str) -> None:
         "mfu": round(mfu, 4),
         "peak_tflops_per_chip": peak,
         # the RESOLVED knob set this number was measured under — ground
-        # truth for tools/apply_ladder.py (reconstructing knobs from CLI
-        # flags drifts once TUNED.json changes the defaults). Batch is
+        # truth for tools/apply_ladder.py and tools/perf_gate.py
+        # (reconstructing knobs from CLI flags drifts once TUNED.json
+        # changes the defaults). KNOB_PAYLOAD_KEYS exactly; batch is
         # PER-CHIP: img/s/chip numbers only compare at equal per-chip batch,
         # independent of how many devices the host had
-        "knobs": {"batch_per_chip": cfg.batch_size // n_dev,
-                  "remat_policy": cfg.remat_policy,
-                  "scan_blocks": cfg.scan_blocks,
-                  "scan_unroll": cfg.scan_unroll,
-                  "remat_window": cfg.remat_window,
-                  "grad_accum_steps": cfg.grad_accum_steps,
-                  "param_gather_dtype": cfg.resolved_param_gather_dtype,
-                  "grad_reduce_dtype": cfg.grad_reduce_dtype,
-                  "gather_overlap": cfg.gather_overlap,
-                  "fused_optimizer": cfg.fused_optimizer},
+        "knobs": knob_payload(cfg, n_dev),
         **({"comm": comm} if comm is not None else {}),
     })
 
@@ -972,67 +965,14 @@ def main():
                    help="which train preset --preset e2e drives from the "
                         "native JPEG loader (default: the preset this "
                         "host's core count can feed)")
-    p.add_argument("--batch_size", type=int, default=0)
-    # default resolved per preset in bench_train: dots_attn_saveable measured
-    # fastest on v5e where activations fit (192.9 > dots_saveable 190.2 on
-    # l14); the 10B flagship keeps none_saveable (minimal HBM residency is
-    # what makes it fit)
-    p.add_argument("--remat_policy", default=None,
-                   choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
-    p.add_argument("--no_grad_ckpt", action="store_false", dest="grad_ckpt")
-    p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
-                   default=None,
-                   help="unroll blocks instead of lax.scan (the scan's "
-                        "dus-stacking constrains wgrad fusion layouts; "
-                        "default resolves per preset — see "
-                        "default_scan_blocks; --scan_unroll forces the scan)")
-    p.add_argument("--scan_unroll", type=int, default=0,
-                   help="blocks per scan step (0 = preset default); keeps the "
-                        "stacked param tree, frees cross-block fusion")
-    p.add_argument("--remat_window", type=int, default=-1,
-                   help=">1: remat around groups of this many blocks "
-                        "(functional scan; residuals dus-stack once per "
-                        "group — the wgrad stacking experiment); 0 = "
-                        "explicit per-block remat; -1 = tuned/preset default")
-    p.add_argument("--moe_impl", default=None, choices=["gather", "einsum"],
-                   help="MoE dispatch/combine A/B (vitax/models/moe.py): "
-                        "einsum (GShard one-hot, default — measured fastest "
-                        "on v5e) vs gather (slot-index scatter+gathers)")
-    p.add_argument("--grad_accum_steps", type=int, default=1,
-                   help="K > 1: accumulate grads over K microbatches inside "
-                        "the jitted step (images/sec vs K trade on the train "
-                        "presets; an explicit A/B knob like --batch_size)")
-    p.add_argument("--att_dropout", type=float, default=None,
-                   help="attention-dropout A/B arm (in-kernel dropout path)")
-    p.add_argument("--param_gather_dtype", default=None,
-                   choices=["bfloat16", "float32"],
-                   help="comm-precision A/B arm: dtype the FSDP param "
-                        "collectives move (None = Config default: follow "
-                        "--dtype, i.e. bf16 gathers on the bf16 presets)")
-    p.add_argument("--grad_reduce_dtype", default="float32",
-                   choices=["float32", "bfloat16"],
-                   help="comm-precision A/B arm: dtype the grad "
-                        "reduce-scatter/all-reduce moves (float32 = exact "
-                        "pre-policy numerics)")
-    p.add_argument("--gather_overlap", default="auto",
-                   choices=["auto", "off", "on"],
-                   help="overlap A/B arm: double-buffered ZeRO-3 block-param "
-                        "gathers prefetched through the layer-scan carry "
-                        "(off = exact pre-overlap schedule; auto = on "
-                        "whenever ZeRO-3 + scanned blocks + per-block remat "
-                        "are active)")
-    p.add_argument("--fused_optimizer", default="auto",
-                   choices=["auto", "off", "on"],
-                   help="optimizer A/B arm: one-pass Pallas fused clip+AdamW "
-                        "update over the sharded state (off = exact optax "
-                        "chain; auto = on where the kernels lower to real "
-                        "Mosaic, i.e. TPU)")
+    # the shared knob-flag group (vitax/tune/knobs.py): same surface as
+    # tools/profile_step.py, tools/aot_topology.py and tools/autotune.py,
+    # plus --preset_file to replay a committed autotune winner
+    add_knob_args(p)
     p.add_argument("--comm_audit", action="store_true",
                    help="embed the tools/comm_audit.py collective report "
                         "(op/dtype/bytes per step) in the BENCH payload; "
                         "costs one extra AOT compile")
-    p.add_argument("--no_flash_attention", action="store_false",
-                   dest="use_flash_attention")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=8)
     p.add_argument("--data_images", type=int, default=256,

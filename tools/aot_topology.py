@@ -39,8 +39,43 @@ def _abstract_key():
     return jax.eval_shape(lambda: jax.random.key(0))
 
 
+def knob_overrides(args) -> dict:
+    """Config-kwarg overrides from the shared knob group (+ --preset_file).
+
+    A committed autotune preset applies first (its knobs become the config
+    baseline for every topology compiled); explicit CLI knobs override on
+    top — the same explicit-wins rule as bench.py. The preset's batch is
+    per-chip, so it travels as the special "_batch_per_chip" key and is
+    translated once the topology's device count is known."""
+    from vitax.tune.knobs import knobs_from_args
+    out = {}
+    if getattr(args, "preset_file", ""):
+        from vitax.tune.preset import config_defaults_from_preset, load_preset
+        preset = load_preset(args.preset_file)
+        out.update(config_defaults_from_preset(preset))
+        out["_batch_per_chip"] = int(preset["knobs"]["batch_per_chip"])
+    kn = knobs_from_args(args)
+    kn.apply_to_preset_kw(out)  # explicit non-scan knobs (incl. batch_size)
+    if kn.batch_size:
+        out.pop("_batch_per_chip", None)  # explicit global batch wins
+    if args.remat_policy is not None:
+        out["remat_policy"] = args.remat_policy
+    if args.scan_blocks is not None:
+        out["scan_blocks"] = args.scan_blocks
+    if args.scan_unroll:
+        out["scan_unroll"] = args.scan_unroll
+    if args.remat_window >= 0:
+        out["remat_window"] = args.remat_window
+    if not args.grad_ckpt:
+        out["grad_ckpt"] = False
+    if not args.use_flash_attention:
+        out["use_flash_attention"] = False
+    return out
+
+
 def compile_for_topology(tag: str, topo_name: str, cfg_kw: dict,
-                         kernels: bool = False) -> dict:
+                         kernels: bool = False,
+                         overrides: dict = None) -> dict:
     import jax
     import jax.numpy as jnp
     from jax.experimental import topologies
@@ -54,6 +89,13 @@ def compile_for_topology(tag: str, topo_name: str, cfg_kw: dict,
 
     td = topologies.get_topology_desc(topo_name, "tpu")
     n_dev = len(td.devices)
+    cfg_kw = dict(cfg_kw)
+    if overrides:
+        ov = dict(overrides)
+        bpc = ov.pop("_batch_per_chip", None)
+        if bpc:
+            cfg_kw["batch_size"] = bpc * n_dev
+        cfg_kw.update(ov)
     cfg = Config(num_classes=1000, warmup_steps=0, **cfg_kw).validate()
     mesh = build_mesh(cfg, devices=list(td.devices))
     attention_impl = None
@@ -195,10 +237,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="+", default=["10b", "60b"],
                     choices=list(CONFIGS))
+    # shared knob group (vitax/tune/knobs.py): A/B a knob or replay a
+    # committed autotune preset against a pod topology without editing
+    # CONFIGS — explicit flags override each config entry
+    from vitax.tune.knobs import add_knob_args
+    add_knob_args(ap)
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "AOT_TOPOLOGY.json"))
     args = ap.parse_args()
+    overrides = knob_overrides(args)
+    if overrides:
+        print(f"[aot_topology] knob overrides: {overrides}", flush=True)
 
     results = []
     for tag in args.configs:
@@ -208,7 +258,8 @@ def main():
             os.environ["VITAX_FORCE_MOSAIC"] = "1"
         print(f"[aot_topology] compiling {tag} for {topo} "
               f"(kernels={kernels}) ...", flush=True)
-        rec = compile_for_topology(tag, topo, kw, kernels=kernels)
+        rec = compile_for_topology(tag, topo, kw, kernels=kernels,
+                                   overrides=overrides)
         os.environ.pop("VITAX_FORCE_MOSAIC", None)
         print(json.dumps(rec), flush=True)
         results.append(rec)

@@ -29,7 +29,11 @@ for path in vitax/telemetry tools/metrics_report.py \
             vitax/ops/dequant_matmul.py tests/test_dequant_matmul.py \
             vitax/serve/fleet/autoscale.py vitax/serve/fleet/placement.py \
             vitax/serve/fleet/agent.py vitax/serve/fleet/cache.py \
-            tests/test_cache.py tests/test_autoscale.py; do
+            tests/test_cache.py tests/test_autoscale.py \
+            vitax/tune vitax/tune/knobs.py vitax/tune/cost.py \
+            vitax/tune/driver.py vitax/telemetry/schema.py \
+            tools/autotune.py tools/perf_gate.py presets \
+            tests/test_autotune.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
@@ -58,6 +62,13 @@ if [ "${VITAX_LINT_SKIP_INVARIANTS:-0}" != "1" ]; then
         --arms zero3_overlap fused serve serve_quant serve_fp8 \
                serve_actquant || exit 1
 fi
+
+# perf-data schema + compile-only cost-model ranking: validates every
+# BENCH_r*.json and autotune trial JSONL in the repo, and asserts the cost
+# model orders the known-ordered knob pairs (no hardware needed). The
+# trajectory regression gate itself runs in CI via the same tool without
+# the flags.
+python tools/perf_gate.py --validate --check_ranking --json >/dev/null || exit 1
 
 if ! python -m flake8 --version >/dev/null 2>&1; then
     echo "lint: flake8 not installed; skipping (pip install flake8 to enable)"

@@ -25,30 +25,11 @@ def main():
                    choices=["tiny", "b16", "b16_moe", "l14", "10b", "10b_slice"])
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--batch_size", type=int, default=0)
-    p.add_argument("--remat_policy", default=None,
-                   choices=["none_saveable", "dots_saveable", "dots_attn_saveable"])
-    p.add_argument("--no_scan_blocks", action="store_false", dest="scan_blocks",
-                   default=None)
-    p.add_argument("--scan_unroll", type=int, default=0)
-    p.add_argument("--remat_window", type=int, default=-1)
-    p.add_argument("--grad_accum_steps", type=int, default=1)
-    p.add_argument("--param_gather_dtype", default=None,
-                   choices=["bfloat16", "float32"],
-                   help="comm-precision A/B: dtype the FSDP param gathers "
-                        "move (None = follow --dtype)")
-    p.add_argument("--grad_reduce_dtype", default="float32",
-                   choices=["float32", "bfloat16"],
-                   help="comm-precision A/B: dtype the grad reduction moves")
-    p.add_argument("--gather_overlap", default="auto",
-                   choices=["auto", "off", "on"],
-                   help="overlap A/B: prefetch next block-group's ZeRO-3 "
-                        "gathers through the scan carry (off = use-site "
-                        "gathers, the pre-overlap schedule)")
-    p.add_argument("--fused_optimizer", default="auto",
-                   choices=["auto", "off", "on"],
-                   help="optimizer A/B: one-pass Pallas fused clip+AdamW "
-                        "(off = exact optax chain)")
+    # the shared knob-flag group (vitax/tune/knobs.py): identical surface to
+    # bench.py so a trace explains exactly the config the bench measured,
+    # --preset_file included (profile a committed autotune winner)
+    from vitax.tune.knobs import add_knob_args, knob_payload, knobs_from_args
+    add_knob_args(p)
     p.add_argument("--out", default="/tmp/vitax_profile")
     args = p.parse_args()
 
@@ -69,30 +50,21 @@ def main():
     device_kind = jax.devices()[0].device_kind  # vtx: ignore[VTX104] CLI entry point: labels the backend being profiled
     # presets and remat defaults come FROM bench.py so traces explain exactly
     # the configs the bench measures
-    from bench import train_presets
-    kw = train_presets(n_dev)[args.preset]
-    if args.batch_size:
-        kw["batch_size"] = args.batch_size
-    from bench import resolve_bench_knobs
-    if args.grad_accum_steps > 1:
-        kw["grad_accum_steps"] = args.grad_accum_steps
+    from bench import apply_preset_file, resolve_bench_knobs, train_presets
+    apply_preset_file(args, n_dev)
+    kn = knobs_from_args(args)
+    kw = kn.apply_to_preset_kw(train_presets(n_dev)[args.preset])
     (args.scan_blocks, args.scan_unroll, args.remat_window,
      args.remat_policy) = resolve_bench_knobs(
         args.scan_blocks, args.scan_unroll, args.remat_window,
         args.remat_policy, args.preset,
-        other_explicit=bool(args.batch_size) or args.grad_accum_steps > 1)
-    if args.param_gather_dtype:
-        kw["param_gather_dtype"] = args.param_gather_dtype
-    if args.grad_reduce_dtype != "float32":
-        kw["grad_reduce_dtype"] = args.grad_reduce_dtype
-    if args.gather_overlap != "auto":
-        kw["gather_overlap"] = args.gather_overlap
-    if args.fused_optimizer != "auto":
-        kw["fused_optimizer"] = args.fused_optimizer
+        other_explicit=kn.other_explicit())
     cfg = Config(num_classes=1000, warmup_steps=0,
-                 remat_policy=args.remat_policy,
+                 remat_policy=args.remat_policy, grad_ckpt=args.grad_ckpt,
                  scan_blocks=args.scan_blocks, scan_unroll=args.scan_unroll,
-                 remat_window=args.remat_window, **kw).validate()
+                 remat_window=args.remat_window,
+                 use_flash_attention=args.use_flash_attention, **kw).validate()
+    print("knobs:", json.dumps(knob_payload(cfg, n_dev), sort_keys=True))
 
     mesh = build_mesh(cfg)
     model = build_model(cfg, attention_impl=make_attention_impl(cfg, mesh))

@@ -869,5 +869,20 @@ def config_fields_from_namespace(ns: argparse.Namespace) -> dict:
 
 
 def parse_config(argv: Optional[Tuple[str, ...]] = None) -> Config:
-    ns = build_parser().parse_args(argv)
+    """Two-phase parse so --preset_file (a committed autotune winner,
+    presets/<model>_<topology>.json) becomes the DEFAULTS layer: the preset's
+    resolved knobs are installed via parser.set_defaults() and the command
+    line is re-parsed, so an explicit CLI flag still wins over the preset.
+    batch_size stays at the trainer's own default/flag — the preset stores
+    per-chip batch and the device count is unknown at parse time."""
+    parser = build_parser()
+    parser.add_argument("--preset_file", default="",
+                        help="autotune preset JSON whose knobs become the "
+                             "parser defaults (explicit flags win)")
+    ns = parser.parse_args(argv)
+    if ns.preset_file:
+        from vitax.tune.preset import config_defaults_from_preset, load_preset
+        parser.set_defaults(**config_defaults_from_preset(
+            load_preset(ns.preset_file)))
+        ns = parser.parse_args(argv)
     return Config(**config_fields_from_namespace(ns)).validate()

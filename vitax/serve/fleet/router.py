@@ -253,7 +253,7 @@ class Router:
                 topk = json.loads(body.decode("utf-8")).get("topk")
                 if topk is not None:
                     return int(topk)
-            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] a malformed body keys as default; the replica 400s it
+            except Exception:  # noqa: BLE001 # vtx: ignore[VTX106] malformed body keys as default; the replica 400s it
                 pass
         return "default"
 

@@ -1,0 +1,24 @@
+"""`python -m vitax.train` — the module-form training entry point.
+
+Identical surface to run_vit_training.py (parse_config's full flag set,
+--preset_file included, so a committed autotune winner drives a real run:
+`python -m vitax.train --fake_data --preset_file presets/l14_v5e-1.json`).
+Backend pinning must happen before anything touches jax.devices(), hence
+the force_cpu_if_requested() call ahead of the train import.
+"""
+
+from vitax.platform import force_cpu_if_requested
+
+force_cpu_if_requested()
+
+from vitax.config import parse_config  # noqa: E402
+from vitax.train.loop import train  # noqa: E402
+
+
+def main(argv=None):
+    cfg = parse_config(argv)
+    train(cfg)
+
+
+if __name__ == "__main__":
+    main()

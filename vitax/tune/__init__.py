@@ -1,0 +1,32 @@
+"""vitax.tune — the self-driving performance loop.
+
+Subsystem map:
+  knobs     the ONE definition of the bench/profiler/autotuner knob surface:
+            a dataclass + the shared argparse group + the resolved-knob
+            payload every measured number records (bench.py, tools/
+            profile_step.py, tools/aot_topology.py and tools/autotune.py all
+            import it, so knob names and defaults cannot drift)
+  preset    committable winning-knob JSON under presets/ — emitted by the
+            autotuner per (model preset, topology), loaded back via
+            --preset_file by bench.py, tools/profile_step.py and
+            python -m vitax.train
+  cost      compile-only cost model: analytic step-time decomposition
+            (compute + remat recompute + exposed collective bytes +
+            optimizer traffic) plus the AOT compile probe (partitioned-HLO
+            collective bytes, compiler memory_analysis) and the
+            known-ordered knob pairs CPU CI pins the ranking on
+  space     deterministic candidate enumeration over the knob space,
+            filtered through Config.validate()
+  driver    the search driver: analytic rank -> compile prune -> (on TPU)
+            successive-halving measured windows, every trial a schema'd
+            JSONL record (kind:"autotune_trial")
+
+Entry points: tools/autotune.py (search + preset emit) and
+tools/perf_gate.py (regression gate + schema validation + ranking pins).
+"""
+
+from vitax.tune.knobs import (  # noqa: F401
+    KNOB_PAYLOAD_KEYS, Knobs, add_knob_args, knob_payload, knobs_from_args)
+from vitax.tune.preset import (  # noqa: F401
+    PRESET_SCHEMA, apply_preset_to_args, config_defaults_from_preset,
+    load_preset, make_preset, preset_path, save_preset)

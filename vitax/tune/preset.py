@@ -1,0 +1,142 @@
+"""Committable winning-knob presets under presets/.
+
+The autotuner emits one JSON file per (model preset, topology):
+
+    {"schema": 1, "kind": "vitax_preset", "model_preset": "l14",
+     "topology": "v5e:1",
+     "knobs": {<KNOB_PAYLOAD_KEYS, resolved — see vitax/tune/knobs.py>},
+     "serve": {"serve_max_batch": 8, "max_batch_wait_ms": 5.0},
+     "source": {"mode": "compile_only" | "measured", "trial_id": ...,
+                "cost_step_s": ..., "images_per_sec_chip": ...,
+                "created": "<iso8601>"}}
+
+Loaded back via --preset_file by bench.py, tools/profile_step.py and
+python -m vitax.train. Application rule everywhere: the preset fills every
+knob still at its sentinel default; an explicit CLI flag wins. Because the
+preset stores the RESOLVED knob set, applying it pins every knob explicitly
+— TUNED.json defaults cannot leak under a preset, so
+`bench.py --preset_file <emitted preset>` reproduces the winning knob set
+exactly (the acceptance contract, pinned in tests/test_autotune.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+from vitax.tune.knobs import KNOB_PAYLOAD_KEYS
+
+PRESET_SCHEMA = 1
+PRESET_KIND = "vitax_preset"
+
+
+def preset_path(root: str, model_preset: str, topology: str) -> str:
+    """Canonical committable location: presets/<model>_<topology>.json with
+    the topology sanitized for filenames (v5e:2x4 -> v5e-2x4)."""
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "-", topology)
+    return os.path.join(root, f"{model_preset}_{safe}.json")
+
+
+def make_preset(model_preset: str, topology: str, knobs: dict,
+                serve: Optional[dict] = None,
+                source: Optional[dict] = None) -> dict:
+    missing = [k for k in KNOB_PAYLOAD_KEYS if k not in knobs]
+    assert not missing, f"preset knobs missing {missing}"
+    return {
+        "schema": PRESET_SCHEMA,
+        "kind": PRESET_KIND,
+        "model_preset": model_preset,
+        "topology": topology,
+        "knobs": {k: knobs[k] for k in KNOB_PAYLOAD_KEYS},
+        "serve": dict(serve or {}),
+        "source": dict(source or {}),
+    }
+
+
+def save_preset(path: str, preset: dict) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(preset, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_preset(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        preset = json.load(f)
+    if not isinstance(preset, dict) or preset.get("kind") != PRESET_KIND:
+        raise ValueError(f"{path}: not a vitax preset "
+                         f"(kind={preset.get('kind') if isinstance(preset, dict) else type(preset).__name__!r})")
+    if preset.get("schema") != PRESET_SCHEMA:
+        raise ValueError(f"{path}: preset schema {preset.get('schema')!r}, "
+                         f"expected {PRESET_SCHEMA}")
+    knobs = preset.get("knobs")
+    if not isinstance(knobs, dict):
+        raise ValueError(f"{path}: missing knobs object")
+    missing = [k for k in KNOB_PAYLOAD_KEYS if k not in knobs]
+    if missing:
+        raise ValueError(f"{path}: preset knobs missing {missing}")
+    return preset
+
+
+def apply_preset_to_args(preset: dict, args, n_dev: int) -> list:
+    """Fill bench/profiler-style knob args (add_knob_args surface) from a
+    loaded preset. Only knobs still at their sentinel default are touched —
+    an explicit CLI flag wins. Returns the list of fields applied.
+
+    batch: the preset stores PER-CHIP batch; --batch_size is global, so the
+    translation needs the live device count (call after backend init)."""
+    k = preset["knobs"]
+    applied = []
+
+    def setd(attr, sentinel, value):
+        if hasattr(args, attr) and getattr(args, attr) == sentinel:
+            setattr(args, attr, value)
+            applied.append(attr)
+
+    setd("batch_size", 0, int(k["batch_per_chip"]) * max(n_dev, 1))
+    setd("remat_policy", None, k["remat_policy"])
+    setd("scan_blocks", None, bool(k["scan_blocks"]))
+    if k["scan_blocks"]:
+        # unroll is a scan knob; with scan off the resolved value is the
+        # model default and pinning it would contradict --no_scan_blocks
+        setd("scan_unroll", 0, int(k["scan_unroll"]))
+    setd("remat_window", -1, int(k["remat_window"]))
+    setd("grad_ckpt", True, bool(k["grad_ckpt"]))
+    setd("use_flash_attention", True, bool(k["use_flash_attention"]))
+    setd("grad_accum_steps", 1, int(k["grad_accum_steps"]))
+    setd("param_gather_dtype", None, k["param_gather_dtype"])
+    setd("grad_reduce_dtype", "float32", k["grad_reduce_dtype"])
+    setd("gather_overlap", "auto", k["gather_overlap"])
+    setd("fused_optimizer", "auto", k["fused_optimizer"])
+    return applied
+
+
+def config_defaults_from_preset(preset: dict) -> dict:
+    """Config-field defaults from a preset, for python -m vitax.train:
+    parse_config() re-parses with these as parser defaults, so explicit
+    CLI flags still win. batch_per_chip is deliberately NOT mapped —
+    --batch_size is the global batch and the trainer's device count is not
+    known at parse time; set it explicitly for multi-host runs."""
+    k = preset["knobs"]
+    out = {
+        "remat_policy": k["remat_policy"],
+        "grad_ckpt": bool(k["grad_ckpt"]),
+        "scan_blocks": bool(k["scan_blocks"]),
+        "scan_unroll": max(int(k["scan_unroll"]), 1),
+        "remat_window": max(int(k["remat_window"]), 0),
+        "use_flash_attention": bool(k["use_flash_attention"]),
+        "grad_accum_steps": int(k["grad_accum_steps"]),
+        "param_gather_dtype": k["param_gather_dtype"],
+        "grad_reduce_dtype": k["grad_reduce_dtype"],
+        "gather_overlap": k["gather_overlap"],
+        "fused_optimizer": k["fused_optimizer"],
+    }
+    serve = preset.get("serve") or {}
+    if "serve_max_batch" in serve:
+        out["serve_max_batch"] = int(serve["serve_max_batch"])
+    if "max_batch_wait_ms" in serve:
+        out["max_batch_wait_ms"] = float(serve["max_batch_wait_ms"])
+    return out
