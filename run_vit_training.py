@@ -17,6 +17,11 @@ from vitax.train.loop import train
 def main(argv=None):
     cfg = parse_config(argv)
     train(cfg)
+    # multi-process runs must also EXIT together: a rank that wins the
+    # teardown race kills the coordination service under its peers and a
+    # clean drain reads as dirty (see vitax/distributed.orderly_shutdown)
+    from vitax.distributed import orderly_shutdown
+    orderly_shutdown()
 
 
 if __name__ == "__main__":

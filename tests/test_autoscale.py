@@ -227,6 +227,101 @@ def test_scale_out_failure_contained_and_cooled_down():
     assert len(attempts) == 2
 
 
+# --- arbiter escalation (request_capacity) -------------------------------------
+
+
+def _browned_out_manager():
+    """One READY replica advertising brownout: a pressure signal that needs
+    no admission controller plumbing."""
+    m, _ = mk_manager(n_ready=1)
+    r = m.find("r0")
+    with m._lock:
+        r.last_health = {"degraded": True}
+    return m
+
+
+def test_escalates_at_max_replicas_instead_of_stalling():
+    """Sustained pressure at the --max_replicas ceiling used to cool down
+    silently; with a request_capacity closure it asks the arbiter, counts
+    the escalation, and emits the autoscale event with outcome
+    "escalated"."""
+    rec = DummyRecorder()
+    m = _browned_out_manager()
+    asks = []
+    a = Autoscaler(m, min_replicas=1, max_replicas=1,  # already AT ceiling
+                   scale_out=lambda: (_ for _ in ()).throw(
+                       AssertionError("must not spawn at the ceiling")),
+                   request_capacity=lambda reason: asks.append(reason),
+                   dwell_s=2.0, cooldown_s=5.0, recorder=rec)
+    assert a.tick(now=0.0) is None            # pressure streak starts
+    assert a.tick(now=2.0) == "escalated"     # dwell met -> ask the arbiter
+    assert asks == ["brownout"]
+    assert a.escalations_total == 1
+    assert a.snapshot()["escalations_total"] == 1
+    event = dict(rec.events[-1][1])
+    assert rec.events[-1][0] == "autoscale"
+    assert event["event"] == "scale_out"
+    assert event["outcome"] == "escalated"
+    assert event["reason"] == "brownout"
+    # the ask opens the normal cooldown: no repeat spam while waiting for
+    # the borrowed capacity to arrive via /fleet/adopt
+    assert a.tick(now=4.0) is None
+    assert a.tick(now=7.0) == "escalated"     # cooldown over, still starved
+    assert len(asks) == 2
+
+
+def test_escalates_when_every_agent_slot_is_full():
+    """A scale-out that fails below the ceiling (every placement agent
+    409'd) escalates too — same starvation, different shape."""
+    rec = DummyRecorder()
+    m = _browned_out_manager()
+    asks = []
+
+    def scale_out():
+        from vitax.serve.fleet.placement import AgentFullError
+        raise AgentFullError("agent at capacity")
+
+    a = Autoscaler(m, min_replicas=1, max_replicas=3, scale_out=scale_out,
+                   request_capacity=lambda reason: asks.append(reason),
+                   dwell_s=2.0, cooldown_s=5.0, recorder=rec)
+    a.tick(now=0.0)
+    assert a.tick(now=2.0) == "escalated"
+    assert asks == ["brownout"] and a.escalations_total == 1
+    kinds = [p.get("event") for k, p in rec.events if k == "autoscale"]
+    assert kinds == ["scale_out_failed", "scale_out"]
+    assert rec.events[-1][1]["outcome"] == "escalated"
+
+
+def test_escalation_failure_contained_and_cooled_down():
+    """An unreachable arbiter must not kill the loop: the failure is
+    recorded, the cooldown still opens, and nothing counts as escalated."""
+    rec = DummyRecorder()
+    m = _browned_out_manager()
+
+    def request_capacity(reason):
+        raise ConnectionError("arbiter unreachable")
+
+    a = Autoscaler(m, min_replicas=1, max_replicas=1,
+                   request_capacity=request_capacity,
+                   dwell_s=2.0, cooldown_s=5.0, recorder=rec)
+    a.tick(now=0.0)
+    assert a.tick(now=2.0) is None
+    assert a.escalations_total == 0
+    assert rec.events[-1][1]["event"] == "escalate_failed"
+    assert a.tick(now=4.0) is None            # cooling down, no retry spam
+
+
+def test_no_escalation_without_request_capacity():
+    """Without the closure the old behavior holds: the ceiling just
+    clamps (covered above), and a failed provision only records
+    scale_out_failed."""
+    m = _browned_out_manager()
+    a = Autoscaler(m, min_replicas=1, max_replicas=1, dwell_s=2.0)
+    a.tick(now=0.0)
+    assert a.tick(now=2.0) is None
+    assert a.escalations_total == 0
+
+
 # --- scale-in: drain before terminate ------------------------------------------
 
 
@@ -303,6 +398,7 @@ def test_snapshot_shape():
     snap = a.snapshot()
     assert snap == {"min_replicas": 1, "max_replicas": 4,
                     "scale_out_total": 0, "scale_in_total": 0,
+                    "escalations_total": 0,
                     "shed_rate_per_s": 0.0, "draining": None,
                     "last_event": None}
 

@@ -1244,7 +1244,7 @@ def test_metrics_report_growth_counters(tmp_path):
     summary = metrics_report.summarize(str(path))
     assert summary["autoscale_events"] == {
         "scale_out": 1, "scale_in": 2, "retires": 1,
-        "scale_out_failures": 1, "forced_drains": 1}
+        "scale_out_failures": 1, "forced_drains": 1, "escalations": 0}
     assert summary["cache_hits"] == 2
     assert summary["cache_hit_rate"] == 0.25
     assert summary["batch_fill_p50"] == 1.0   # median of [0.25, 1.0, 1.0]

@@ -33,7 +33,9 @@ for path in vitax/telemetry tools/metrics_report.py \
             vitax/tune vitax/tune/knobs.py vitax/tune/cost.py \
             vitax/tune/driver.py vitax/telemetry/schema.py \
             tools/autotune.py tools/perf_gate.py presets \
-            tests/test_autotune.py; do
+            tests/test_autotune.py \
+            vitax/arbiter vitax/arbiter/ledger.py vitax/arbiter/policy.py \
+            vitax/arbiter/daemon.py tests/test_arbiter.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1

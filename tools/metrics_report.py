@@ -136,6 +136,29 @@ def summarize(path: str) -> dict:
         "forced_drains": sum(1 for e in autoscale
                              if e.get("event") == "scale_in"
                              and e.get("forced")),
+        # a maxed-out (or agent-full) fleet asking the chip arbiter for a
+        # whole host instead of failing the scale-out
+        "escalations": sum(1 for e in autoscale
+                           if e.get("event") == "scale_out"
+                           and e.get("outcome") == "escalated"),
+    }
+    # chip arbitration (vitax/arbiter/): borrow/return/deny traffic, with
+    # denies bucketed by the policy's reason so hysteresis is visible
+    arbiter = [e for e in events if e.get("kind") == "arbiter"]
+    deny_reasons: dict = {}
+    for e in arbiter:
+        if e.get("event") == "deny":
+            reason = str(e.get("reason", "unknown"))
+            deny_reasons[reason] = deny_reasons.get(reason, 0) + 1
+    summary["arbiter_events"] = {
+        "requests": sum(1 for e in arbiter if e.get("event") == "request"),
+        "borrows": sum(1 for e in arbiter if e.get("event") == "borrow"),
+        "returns": sum(1 for e in arbiter if e.get("event") == "return"),
+        "borrow_failures": sum(1 for e in arbiter
+                               if e.get("event") == "borrow_failed"),
+        "return_failures": sum(1 for e in arbiter
+                               if e.get("event") == "return_failed"),
+        "denies": deny_reasons,
     }
     # prediction cache (vitax/serve/fleet/cache.py): hit events carry
     # running totals, so the LAST one yields the rate (misses are counted
@@ -171,6 +194,16 @@ def summarize(path: str) -> dict:
         "elastic_resumes": sum(1 for e in control
                                if e.get("event") == "elastic_resume"),
     }
+    # the training pod's process-count history: every topology flip the
+    # control plane saw (supervisor/arbiter `topology_change` observations
+    # and the loop's own `elastic_resume` actions), in record order — an
+    # arbiter borrow/return drill reads N -> N-1 -> N here
+    summary["train_topology_timeline"] = [
+        {"event": e.get("event"),
+         "from_processes": e.get("from_processes"),
+         "to_processes": e.get("to_processes")}
+        for e in control
+        if e.get("event") in ("topology_change", "elastic_resume")]
     summary["hang_hard_exits"] = sum(1 for e in events
                                      if e.get("kind") == "hang_hard_exit")
     # zero-stall checkpointing + peer replication (vitax/checkpoint/
@@ -359,7 +392,24 @@ def print_human(summary: dict) -> None:
     if any(auto.values()):
         print(f"  autoscale: {auto['scale_out']} out, {auto['scale_in']} in "
               f"({auto['retires']} retires, {auto['forced_drains']} forced "
-              f"drains, {auto['scale_out_failures']} failed provisions)")
+              f"drains, {auto['scale_out_failures']} failed provisions, "
+              f"{auto.get('escalations', 0)} arbiter escalation(s))")
+    arb = summary.get("arbiter_events") or {}
+    if any(arb.values()):
+        denies = arb.get("denies") or {}
+        deny_desc = ", ".join(f"{k}:{v}" for k, v in sorted(denies.items()))
+        print(f"  chip arbiter: {arb['borrows']} borrow(s), "
+              f"{arb['returns']} return(s), {arb['requests']} capacity "
+              f"request(s), {arb['borrow_failures']} failed borrow(s), "
+              f"{arb['return_failures']} failed return(s)"
+              + (f"; denies {deny_desc}" if denies else ""))
+    timeline = summary.get("train_topology_timeline") or []
+    if timeline:
+        path = " -> ".join(
+            [str(timeline[0]["from_processes"])]
+            + [str(t["to_processes"]) for t in timeline])
+        print(f"  train topology: {path} process(es) across "
+              f"{len(timeline)} transition(s)")
     if summary.get("cache_hits") is not None:
         print(f"  prediction cache: {summary['cache_hits']} hits "
               f"(rate {summary['cache_hit_rate']:.2f})")

@@ -440,7 +440,7 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
             for w in workers:
                 w.join()
             stage_lat = sorted(latencies[counts0[0]:])
-            stage_reports.append({
+            report = {
                 "target_rps": rps,
                 "duration_s": secs,
                 "completed": len(stage_lat),
@@ -449,7 +449,17 @@ def run_bench(url: str, concurrency: int, requests_per_worker: int,
                 "errors": len(errors) - counts0[3],
                 "latency_s_p50": percentile(stage_lat, 0.50),
                 "latency_s_p99": percentile(stage_lat, 0.99),
-            })
+            }
+            if slo_p99_ms > 0:
+                # per-stage SLO verdict: a surge stage that missed while
+                # the fleet grew is visible even when the whole-profile
+                # aggregate attains (and vice versa)
+                stage_p99 = report["latency_s_p99"]
+                report["slo_attained"] = bool(
+                    stage_lat and report["errors"] == 0
+                    and stage_p99 is not None
+                    and stage_p99 * 1000.0 <= slo_p99_ms)
+            stage_reports.append(report)
     else:
         workers = [threading.Thread(
             target=run_worker,
@@ -554,7 +564,9 @@ def print_human(s: dict) -> None:
               f"{st['duration_s']:g}s: {st['completed']} ok, "
               f"{st['shed']} shed, {st['unavailable']} unavailable, "
               f"{st['errors']} errors"
-              + (f", p99 {1e3 * p99:.1f}ms" if p99 is not None else ""))
+              + (f", p99 {1e3 * p99:.1f}ms" if p99 is not None else "")
+              + ("" if "slo_attained" not in st else
+                 f", slo {'ATTAINED' if st['slo_attained'] else 'MISSED'}"))
     weights = s.get("weights")
     if weights:
         print(f"  weights: {weights['weights_dtype']} "

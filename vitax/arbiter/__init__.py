@@ -1,0 +1,33 @@
+"""vitax.arbiter — chip-ledger arbiter for co-located train + serve.
+
+One pod, two tenants: the FSDP training job (vitax/supervise.py restart
+contract, vitax/train/control.py agreed preemption, PR 11 peer-replicated
+elastic resume) and the serving fleet (PR 17 autoscaler + placement
+agents). Neither owns the pod's chips, so before this subsystem a serve
+surge could only shed — the fleet's autoscaler had nowhere to grow once
+every serve-owned host was full. The arbiter closes that gap: it owns a
+leased host ledger (ledger.py), decides borrow/return under a hysteretic
+policy (policy.py), and speaks BOTH sides' existing contracts to move a
+host between tenants (daemon.py):
+
+  borrow: drain training to a joint preemption checkpoint (SIGTERM ->
+  vitax/train/preempt.py -> committed save + clean exit 0), relaunch at
+  N - k processes (elastic resume restores from surviving peer stores in
+  seconds, zero Orbax reads), provision an int8 replica on the freed
+  host via the placement agent's POST /provision, and hand its URL to
+  the fleet router's POST /fleet/adopt.
+
+  return: POST /fleet/release to the router (retire -> drain-to-zero),
+  POST /release to the agent (SIGTERM-drain the replica process), then
+  re-expand training back to N.
+
+`python -m vitax.arbiter` runs the daemon; GET /ledger, GET /metrics and
+the gated POST /policy are its surface. Everything is seam-injected
+(clock, spawn, transport, fleet/agent callables) so the whole state
+machine unit-tests socketless like tests/test_autoscale.py.
+"""
+
+from vitax.arbiter.ledger import OWNERS, HostLedger          # noqa: F401
+from vitax.arbiter.policy import ArbiterPolicy, Decision     # noqa: F401
+from vitax.arbiter.daemon import (                           # noqa: F401
+    Arbiter, TrainDirector, start_arbiter, stop_arbiter)

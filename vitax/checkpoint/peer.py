@@ -368,13 +368,38 @@ class PeerReplicator:
         self._receiver.start()
         return True
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = True) -> None:
         self._stop.set()
         if self._receiver is not None:
             # bounded: a receiver wedged in a KV fetch must not block
             # process exit — warn loudly and leak it instead
             join_or_warn(self._receiver, timeout=self.poll_s + 1.0)
             self._receiver = None
+        if drain and self.process_count > 1 and self.client is not None:
+            self._drain_receive()
+
+    def _drain_receive(self) -> None:
+        """One final bounded fetch of the guard's newest publication. An
+        exit right after a joint preemption save (the arbiter's elastic
+        shrink) must not strand the guard's FINAL shard in the KV: the
+        polling receiver may simply never wake between the save barrier
+        and process exit, and a single-host resume reads only its local
+        store. Every host publishes BEFORE the preemption exit barrier,
+        so by the time the finally-block runs this fetch, the final
+        version is deterministically visible."""
+        try:
+            got = _fetch_blob(self.client,
+                              f"{PEER_KEY_PREFIX}/{self.guard}",
+                              timeout_ms=max(
+                                  int(min(self.poll_s, 0.5) * 1000), 100))
+        except Exception as e:  # noqa: BLE001 — best-effort: the coordinator may already be gone
+            print(f"vitax.peer: final receive from host {self.guard} "
+                  f"failed ({e}); local store keeps its last pulled "
+                  f"version", file=sys.stderr, flush=True)
+            return
+        if got is not None:
+            meta, payload = got
+            self.store.put(meta, payload)
 
     def _receive(self) -> None:
         last_gen = 0
@@ -456,7 +481,14 @@ def negotiate_restore(store: PeerStore, *, process_index: int,
                    default=None)
 
     if process_count <= 1:
-        v = best([c for c in _complete_versions(holdings) if c[2] == 1])
+        # any locally COMPLETE version qualifies, whatever topology wrote
+        # it: _complete_versions demands every shard of the version's own
+        # recorded process count, and assemble_state rebuilds the full
+        # arrays from the shard index ranges. This is what makes an
+        # elastic shrink to one host (the arbiter's borrow path) resume
+        # from its own store with zero Orbax reads — the survivor holds
+        # its self-spill plus its guard's final replica.
+        v = best(_complete_versions(holdings))
         if v is None:
             return None
         meta = next(m for m in holdings.values()
@@ -599,6 +631,7 @@ def assemble_state(parts: List[Tuple[dict, bytes]],
     make_array_from_callback against the abstract state's target shardings,
     so restore is topology-aware exactly like the Orbax path."""
     import jax
+    import jax.numpy as jnp
     from vitax.checkpoint.snapshot import _path_str
     per_path: Dict[str, Dict[Tuple, np.ndarray]] = {}
     for meta, payload in parts:
@@ -626,9 +659,19 @@ def assemble_state(parts: List[Tuple[dict, bytes]],
             raise PeerRestoreError(
                 f"leaf {path!r} only {covered}/{need} elements covered by "
                 f"peer shards — a replica is missing")
+        # Each shard gets an owned copy (never a view into `full`), and the
+        # assembled array is then laundered through a jitted on-device copy
+        # below: the CPU backend can zero-copy-adopt aligned host buffers, so
+        # without the launder the restored state would be backed by adopted
+        # malloc-heap memory that the DONATING train step reuses in place —
+        # observed as NaN a few steps after an elastic peer restore plus glibc
+        # heap corruption at exit. The launder gives the state fresh
+        # XLA-owned buffers, indistinguishable from jit-initialized state.
         out.append(jax.make_array_from_callback(
-            aval.shape, aval.sharding, lambda idx, _f=full: _f[idx]))
-    return jax.tree_util.tree_unflatten(treedef, out)
+            aval.shape, aval.sharding,
+            lambda idx, _f=full: _f[idx].copy()))
+    restored = jax.tree_util.tree_unflatten(treedef, out)
+    return jax.tree.map(jax.jit(jnp.copy), restored)
 
 
 def restore_from_store(store: PeerStore, plan: RestorePlan,

@@ -172,7 +172,13 @@ class HostSnapshot:
         leaves = []
         for i, spec in enumerate(self.specs):
             bufs = self.buffers(i)
-            arrays = [jax.device_put(bufs[slot], device)
+            # device_put an OWNED copy (.copy(), unconditionally), not the
+            # staging buffer itself: the CPU backend may zero-copy-adopt an
+            # aligned numpy buffer, and these buffers are RECYCLED — the
+            # next stage() would overwrite them under Orbax's still-running
+            # async write (torn checkpoint). Same aliasing hazard as
+            # peer.assemble_state's restore callback.
+            arrays = [jax.device_put(bufs[slot].copy(), device)
                       for device, slot in spec.placements]
             leaves.append(jax.make_array_from_single_device_arrays(
                 spec.shape, spec.sharding, arrays))

@@ -192,6 +192,11 @@ class Config:
     peer_grace_s: float = 0.0           # silence window before a peer is declared lost, and the
     #   deadline for the survivor's own exit after the verdict; 0 = default
     #   (10 x peer_heartbeat_s)
+    arbiter_url: str = ""               # chip-arbiter URL (python -m vitax.arbiter): rank 0 posts
+    #   step/progress heartbeats there so borrow policy can gate on
+    #   "training is actually progressing". Host-side reporter thread only
+    #   (vitax/train/control.py ArbiterReporter) — the compiled step
+    #   program is identical with or without it. "" = off
     compile_cache_dir: str = ""         # persistent XLA compile cache (restarts skip recompiles)
     debug_nans: bool = False            # opt-in jax_debug_nans (SURVEY.md section 5, race-detection analog)
     log_memory: bool = True             # include HBM stats in step log
@@ -764,6 +769,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "declared lost, and the survivor's own exit "
                           "deadline after the verdict (0 = 10 x "
                           "--peer_heartbeat_s)")
+    ext.add_argument("--arbiter_url", type=str, default="",
+                     help="chip-arbiter URL (python -m vitax.arbiter): "
+                          "rank 0 posts step/progress heartbeats there so "
+                          "the arbiter's borrow policy sees live training "
+                          "telemetry (host-side thread; the compiled step "
+                          "program is unchanged). \"\" = off")
     ext.add_argument("--fault_plan", type=str, default="",
                      help="JSON fault-injection plan (vitax/faults.py), e.g. "
                           "'{\"site\": \"step\", \"at\": 6, \"action\": "

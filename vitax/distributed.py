@@ -116,6 +116,32 @@ def any_across_processes(value: bool) -> bool:
         np.int32(bool(value)))))
 
 
+def orderly_shutdown() -> None:
+    """Coordinated multi-process exit for a clean (exit 0) run: barrier so
+    every host finishes its teardown first, disconnect from the coordination
+    service, then _exit(0) to skip C++ static destructors.
+
+    Without this, whichever rank exits first tears down the coordination
+    service under its peers: their background PollForError threads turn a
+    COMPLETED run into SIGABRT, and XLA CPU's destructor-time thread races
+    can corrupt the heap after all state is already committed. An elastic
+    drain (vitax/arbiter) runs this gauntlet on every resize — the agreed
+    preemption contract is "every rank exits 0", so the exit itself must be
+    as coordinated as the save. No-op single-process."""
+    if jax.process_count() <= 1:
+        return
+    import sys
+    barrier("vitax_orderly_shutdown")
+    try:
+        jax.distributed.shutdown()  # its own barrier: all ranks disconnect
+    except Exception as e:  # noqa: BLE001 — a dirty disconnect must not fail a committed run
+        print(f"vitax.distributed: shutdown after barrier failed "
+              f"({type(e).__name__}: {e}); exiting anyway", file=sys.stderr)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
 def or_across_processes(value: int) -> int:
     """Bitwise OR of a small non-negative host int over all processes — the
     control plane's word-agreement fold (vitax/train/control.py): every

@@ -414,8 +414,16 @@ def jit_init_sharded(
         cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
             host_tree = jax.jit(init_fn)(jax.device_put(rng, cpu))
-        return jax.tree.map(
-            lambda x, s: jax.device_put(np.asarray(x), s), host_tree, shardings)
+        # device_put of a host array can be zero-copy ADOPTED by the CPU
+        # backend, leaving the params backed by malloc-heap memory that the
+        # donating train step later reuses in place (same hazard as
+        # checkpoint/peer.assemble_state). Launder each leaf through a jitted
+        # on-device copy so the returned tree is backed by fresh XLA-owned
+        # buffers, exactly like the jit-init path below.
+        placed = jax.tree.map(
+            lambda x, s: jax.device_put(np.asarray(x).copy(), s),
+            host_tree, shardings)
+        return jax.tree.map(jax.jit(jnp.copy), placed)
     return jax.jit(init_fn, out_shardings=shardings)(rng)
 
 

@@ -34,7 +34,7 @@ _FLEET_ONLY_FLAGS = (
     # autoscaling + cross-host placement (this PR's fleet growth tier):
     "--min_replicas", "--max_replicas", "--warming_capacity_frac",
     "--autoscale_dwell_s", "--autoscale_cooldown_s", "--autoscale_idle_frac",
-    "--placement_agents",
+    "--placement_agents", "--arbiter_url",
     # router-side caching/batching knobs (Config fields, but meaningless
     # inside a replica process — keep its argv clean):
     "--serve_cache_max", "--serve_cache_ttl_s", "--serve_batch_window_ms",
@@ -145,6 +145,10 @@ def main(argv=None) -> int:
                             "-m vitax.serve.fleet.agent, one per host); "
                             "replicas and scale-outs round-robin across "
                             "them instead of spawning locally")
+    # NOTE: --arbiter_url itself is a Config field (build_parser's ext
+    # group defines it); fleet-side it turns on autoscaler escalation and
+    # the router's /fleet/adopt + /fleet/release hooks below. It stays in
+    # _FLEET_ONLY_FLAGS so replicas never see it.
     ns = parser.parse_args(argv)
     cfg = Config(**config_fields_from_namespace(ns)).validate()
     assert ns.replicas >= 1, f"--replicas must be >= 1, got {ns.replicas}"
@@ -159,7 +163,7 @@ def main(argv=None) -> int:
     from vitax.serve.fleet.admission import AdmissionController
     from vitax.serve.fleet.autoscale import Autoscaler
     from vitax.serve.fleet.cache import PredictionCache
-    from vitax.serve.fleet.placement import PlacementClient
+    from vitax.serve.fleet.placement import AgentFullError, PlacementClient
     from vitax.serve.fleet.replica import ReplicaManager
     from vitax.serve.fleet.router import Router, start_router, stop_router
 
@@ -196,13 +200,23 @@ def main(argv=None) -> int:
             spawn_state["rr"] += 1
         name = f"replica_{i}"
         if agents:
-            client = agents[rr % len(agents)]
-            out = client.provision(strip_flags(argv, _FLEET_ONLY_FLAGS),
-                                   name=name)
-            replica = manager.adopt(out["url"], name=name)
-            with spawn_lock:
-                placed[name] = (client, out["name"])
-            return replica
+            # round-robin, but a full agent (409/AgentFullError) is not the
+            # end: try every other agent before raising — only a fleet with
+            # NO free slot anywhere escalates to the arbiter
+            last_full = None
+            for k in range(len(agents)):
+                client = agents[(rr + k) % len(agents)]
+                try:
+                    out = client.provision(
+                        strip_flags(argv, _FLEET_ONLY_FLAGS), name=name)
+                except AgentFullError as e:
+                    last_full = e
+                    continue
+                replica = manager.adopt(out["url"], name=name)
+                with spawn_lock:
+                    placed[name] = (client, out["name"])
+                return replica
+            raise last_full
         port = base_port + i
         metrics_dir = (os.path.join(cfg.metrics_dir, f"replica_{i}")
                        if cfg.metrics_dir else "")
@@ -226,6 +240,24 @@ def main(argv=None) -> int:
     admission = AdmissionController(
         ns.slo_p99_ms, recorder=recorder,
         warming_capacity_frac=ns.warming_capacity_frac)
+
+    # -- arbiter escalation: when the fleet is at --max_replicas (or every
+    # agent slot is taken) the autoscaler asks the chip arbiter for a
+    # whole host instead of failing. Fire-and-forget POST; the arbiter's
+    # ticker decides, borrows, and calls back on /fleet/adopt.
+    request_capacity = None
+    if ns.arbiter_url:
+        import json as json_mod
+        import urllib.request
+
+        def request_capacity(reason: str):
+            data = json_mod.dumps({"reason": reason}).encode("utf-8")
+            req = urllib.request.Request(
+                ns.arbiter_url.rstrip("/") + "/request", data=data,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                return json_mod.load(resp)
+
     autoscaler = None
     if ns.max_replicas > 0:
         autoscaler = Autoscaler(
@@ -233,7 +265,8 @@ def main(argv=None) -> int:
             max_replicas=ns.max_replicas, scale_out=spawn_replica,
             release=release_replica, dwell_s=ns.autoscale_dwell_s,
             cooldown_s=ns.autoscale_cooldown_s,
-            idle_occupancy=ns.autoscale_idle_frac, recorder=recorder)
+            idle_occupancy=ns.autoscale_idle_frac, recorder=recorder,
+            request_capacity=request_capacity)
         autoscaler.start()
     cache = (PredictionCache(cfg.serve_cache_max,
                              ttl_s=cfg.serve_cache_ttl_s, recorder=recorder)
@@ -247,6 +280,43 @@ def main(argv=None) -> int:
                     cache=cache, autoscaler=autoscaler,
                     batch_window_ms=cfg.serve_batch_window_ms,
                     batch_max=cfg.serve_batch_max or cfg.serve_max_batch)
+
+    if ns.arbiter_url:
+        # the arbiter's side of the loan: adopt() a replica it provisioned
+        # on a borrowed host into rotation, and on return retire -> wait
+        # for in-flight zero -> discard (adopted processes belong to the
+        # arbiter's agent, so discard only forgets the URL)
+        borrow_state = {"next": 0}
+
+        def fleet_adopt(url: str) -> dict:
+            with spawn_lock:
+                k = borrow_state["next"]
+                borrow_state["next"] += 1
+            replica = manager.adopt(url, name=f"borrowed_{k}")
+            return {"adopted": replica.name, "url": url}
+
+        def fleet_release(url: str) -> dict:
+            target = None
+            for name, snap in manager.snapshot().items():
+                if snap.get("url") == url:
+                    target = manager.find(name)
+                    break
+            if target is None:
+                return {"released": None, "url": url}
+            manager.retire(target)
+            pause = threading.Event()
+            waited = 0.0
+            while (manager.in_flight_of(target) > 0
+                   and waited < cfg.serve_request_timeout_s):
+                pause.wait(0.05)
+                waited += 0.05
+            manager.discard(target)
+            return {"released": target.name, "url": url,
+                    "in_flight_at_discard": manager.in_flight_of(target)}
+
+        router.fleet_adopt_fn = fleet_adopt
+        router.fleet_release_fn = fleet_release
+
     httpd = start_router(router, cfg.serve_port)
     scale_desc = (f"autoscale [{min_replicas}, {ns.max_replicas}]"
                   if autoscaler is not None else "static")

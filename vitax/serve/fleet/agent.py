@@ -46,6 +46,10 @@ def build_agent_parser() -> argparse.ArgumentParser:
     parser.add_argument("--replica_max_restarts", type=int, default=10,
                         help="restarts-with-backoff per replica before the "
                              "agent gives up on it")
+    parser.add_argument("--agent_max_replicas", type=int, default=0,
+                        help="replica slots on this host (0 = unbounded); "
+                             "/provision answers 409 once every slot is "
+                             "taken so callers try another host or escalate")
     return parser
 
 
@@ -54,6 +58,7 @@ def main(argv=None) -> int:
     agent = PlacementAgent(
         advertise_host=ns.agent_advertise, base_port=ns.agent_base_port,
         health_interval_s=ns.health_interval_s,
+        max_slots=ns.agent_max_replicas,
         max_restarts=ns.replica_max_restarts)
     httpd = start_agent(agent, ns.agent_port)
     print(f"placement agent: API on :{httpd.server_address[1]}, replicas "

@@ -41,8 +41,12 @@ even the `drain_timeout_s` force path cannot drop accepted work.
 Provisioning is delegated: `scale_out()` returns a new Replica (local
 spawn via ReplicaManager.manage, or a cross-host placement provision +
 adopt — see placement.py) and `release(replica)` frees remote resources
-after a drain. `clock` is injectable so hysteresis is unit-testable with
-no real time (tests/test_autoscale.py).
+after a drain. When local scale-out is DENIED — the fleet is at
+max_replicas, or every placement agent is full — a `request_capacity`
+closure (the chip arbiter, vitax/arbiter) escalates the sustained demand
+to the pod instead of silently cooling down, recorded as an autoscale
+event with outcome "escalated". `clock` is injectable so hysteresis is
+unit-testable with no real time (tests/test_autoscale.py).
 
 Stdlib-only: the router tier must run on a box with no jax.
 """
@@ -72,6 +76,7 @@ class Autoscaler:
                  min_replicas: int = 1, max_replicas: int = 1,
                  scale_out: Optional[Callable[[], object]] = None,
                  release: Optional[Callable[[object], None]] = None,
+                 request_capacity: Optional[Callable[[str], object]] = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  dwell_s: float = DEFAULT_DWELL_S,
                  cooldown_s: float = DEFAULT_COOLDOWN_S,
@@ -100,6 +105,11 @@ class Autoscaler:
         self._clock = clock
         self._scale_out_fn = scale_out
         self._release_fn = release
+        # escalation closure (the chip arbiter, vitax/arbiter): sustained
+        # pressure the fleet CANNOT answer locally — at max_replicas, or
+        # every placement agent full — asks the pod for more chips instead
+        # of silently cooling down
+        self._request_capacity_fn = request_capacity
         self._lock = threading.Lock()
         # hysteresis state (all guarded by _lock)
         self._pressure_since: Optional[float] = None
@@ -112,6 +122,7 @@ class Autoscaler:
         self._drain_deadline = 0.0
         self.scale_out_total = 0
         self.scale_in_total = 0
+        self.escalations_total = 0
         self.last_event: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -177,10 +188,14 @@ class Autoscaler:
                 if self._pressure_since is None:
                     self._pressure_since = now
                 sustained = now - self._pressure_since >= self.dwell_s
-                if (sustained and now >= self._cooldown_until
-                        and sig["active"] < self.max_replicas
-                        and self._scale_out_fn is not None):
-                    action = "scale_out"
+                if sustained and now >= self._cooldown_until:
+                    if (sig["active"] < self.max_replicas
+                            and self._scale_out_fn is not None):
+                        action = "scale_out"
+                    elif self._request_capacity_fn is not None:
+                        # denied locally (ceiling, or nothing to spawn
+                        # with): escalate to the arbiter
+                        action = "escalate"
             else:
                 self._pressure_since = None
                 occupancy = sig["depth"] / max(sig["ready"], 1)
@@ -203,6 +218,8 @@ class Autoscaler:
                 action, pressure = "scale_out", "below_min"
         if action == "scale_out":
             return self._do_scale_out(pressure, now, sig)
+        if action == "escalate":
+            return self._do_escalate(pressure, now, sig)
         if action == "retire":
             return self._do_retire(now, sig)
         return None
@@ -214,6 +231,10 @@ class Autoscaler:
             replica = None
             self._event(event="scale_out_failed", reason=reason,
                         detail=f"{type(e).__name__}: {e}")
+            if self._request_capacity_fn is not None:
+                # "no free agent slot" surfaces here (every placement
+                # agent returned 409): same escalation as the ceiling case
+                return self._do_escalate(reason, now, sig)
         with self._lock:
             self._pressure_since = None
             self._cooldown_until = now + self.cooldown_s
@@ -225,6 +246,31 @@ class Autoscaler:
                                "size": sig["active"] + 1}
         self._event(**self.last_event)
         return "scale_out"
+
+    def _do_escalate(self, reason: str, now: float, sig: dict):
+        """Sustained pressure the fleet cannot answer locally: hand the
+        demand to the arbiter (request_capacity closure) and cool down —
+        the borrowed capacity arrives asynchronously via /fleet/adopt, so
+        this tick's job ends at the ask. The autoscale event grows an
+        `escalated` outcome so a starved fleet is visible in
+        metrics_report, not silent."""
+        try:
+            self._request_capacity_fn(reason)
+        except Exception as e:  # noqa: BLE001 — an unreachable arbiter must not kill the loop
+            self._event(event="escalate_failed", reason=reason,
+                        detail=f"{type(e).__name__}: {e}")
+            with self._lock:
+                self._pressure_since = None
+                self._cooldown_until = now + self.cooldown_s
+            return None
+        with self._lock:
+            self._pressure_since = None
+            self._cooldown_until = now + self.cooldown_s
+            self.escalations_total += 1
+            self.last_event = {"event": "scale_out", "outcome": "escalated",
+                               "reason": reason, "size": sig["active"]}
+        self._event(**self.last_event)
+        return "escalated"
 
     def _do_retire(self, now: float, sig: dict):
         """Start a scale-in: pick the least-loaded READY replica, take it
@@ -308,6 +354,7 @@ class Autoscaler:
                 "max_replicas": self.max_replicas,
                 "scale_out_total": self.scale_out_total,
                 "scale_in_total": self.scale_in_total,
+                "escalations_total": self.escalations_total,
                 "shed_rate_per_s": round(self._shed_rate, 4),
                 "draining": (self._draining.name
                              if self._draining is not None else None),

@@ -10,10 +10,11 @@ adopts the returned URL: `adopt()` health-checks but never restarts,
 because the agent owns the lifecycle — exactly the adopt() contract.
 
 Agent endpoints:
-    GET  /healthz       liveness + replica count
+    GET  /healthz       liveness + replica count + slot accounting
     GET  /replicas      per-replica manager snapshot
     POST /provision     {"argv": [serve flags...], "name": ..., "port": 0}
-                        -> {"name", "url", "port"}  (port 0 = agent picks)
+                        -> {"name", "url", "port"}  (port 0 = agent picks);
+                        409 when every slot is taken (--agent_max_replicas)
     POST /release       {"name": ...} -> drain + terminate that replica
 
 The router-side **PlacementClient** is a thin urllib wrapper; the fleet
@@ -35,6 +36,7 @@ from __future__ import annotations
 import json
 import sys
 import threading
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
@@ -46,22 +48,35 @@ DEFAULT_BASE_PORT = 8100
 DEFAULT_CLIENT_TIMEOUT_S = 30.0
 
 
+class AgentFullError(RuntimeError):
+    """Every replica slot on this agent's host is taken (max_slots).
+    Maps to HTTP 409 on the wire; the fleet CLI tries the next agent and
+    a fleet with NO free agent anywhere escalates to the chip arbiter."""
+
+
 class PlacementAgent:
     """Per-host replica factory over a private ReplicaManager."""
 
     def __init__(self, advertise_host: str = "127.0.0.1",
                  base_port: int = DEFAULT_BASE_PORT,
                  manager: Optional[ReplicaManager] = None,
-                 recorder=None, **manager_kw):
+                 recorder=None, max_slots: int = 0, **manager_kw):
         self.advertise_host = advertise_host
         self.base_port = base_port
         self.manager = manager if manager is not None else ReplicaManager(
             recorder=recorder, **manager_kw)
         self.recorder = recorder
+        # a host has a fixed chip/memory budget: max_slots caps live
+        # replicas (0 = unbounded, the historical behavior)
+        self.max_slots = max_slots
         self.provisions_total = 0
         self.releases_total = 0
         self._next_port = 0
         self._lock = threading.Lock()
+
+    def slots(self) -> dict:
+        return {"used": len(self.manager.snapshot()),
+                "max": self.max_slots}
 
     def provision(self, argv: Sequence[str], name: Optional[str] = None,
                   port: int = 0) -> dict:
@@ -70,6 +85,9 @@ class PlacementAgent:
         if not isinstance(argv, (list, tuple)) or not all(
                 isinstance(a, str) for a in argv):
             raise ValueError("argv must be a list of strings")
+        if self.max_slots and len(self.manager.snapshot()) >= self.max_slots:
+            raise AgentFullError(
+                f"agent at capacity: {self.max_slots} slot(s) in use")
         with self._lock:
             if port == 0:
                 port = self.base_port + self._next_port
@@ -131,7 +149,8 @@ def _make_handler(agent: PlacementAgent):
                 self._reply(200, {
                     "status": "ok",
                     "replicas": len(agent.manager.snapshot()),
-                    "ready": agent.manager.ready_count()})
+                    "ready": agent.manager.ready_count(),
+                    "slots": agent.slots()})
             elif self.path == "/replicas":
                 self._reply(200, agent.snapshot())
             else:
@@ -149,6 +168,12 @@ def _make_handler(agent: PlacementAgent):
                     out = agent.provision(payload.get("argv", []),
                                           name=payload.get("name"),
                                           port=int(payload.get("port", 0)))
+                except AgentFullError as e:
+                    # 409: capacity, not a malformed request — callers
+                    # try their next agent (or escalate to the arbiter)
+                    self._reply(409, {"error": str(e),
+                                      "slots": agent.slots()})
+                    return
                 except ValueError as e:
                     self._reply(400, {"error": str(e)})
                     return
@@ -216,10 +241,19 @@ class PlacementClient:
     def provision(self, argv: List[str], name: Optional[str] = None,
                   port: int = 0) -> dict:
         """{"name", "url", "port"} of a freshly spawned remote replica —
-        adopt() the url into the local fleet to route to it."""
-        return self._http_json(
-            self.agent_url + "/provision",
-            {"argv": list(argv), "name": name, "port": port}, self.timeout_s)
+        adopt() the url into the local fleet to route to it. Raises
+        AgentFullError on the agent's 409 (every slot taken) so callers
+        can distinguish "try another host" from a real failure."""
+        try:
+            return self._http_json(
+                self.agent_url + "/provision",
+                {"argv": list(argv), "name": name, "port": port},
+                self.timeout_s)
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                raise AgentFullError(
+                    f"agent {self.agent_url} at capacity") from e
+            raise
 
     def release(self, name: str) -> dict:
         return self._http_json(self.agent_url + "/release", {"name": name},

@@ -183,6 +183,12 @@ class Router:
         self.hedge_after_ms = hedge_after_ms
         self.cache = cache
         self.autoscaler = autoscaler  # observability only; it owns itself
+        # arbiter plane (POST /fleet/adopt, /fleet/release): the fleet CLI
+        # installs these so a chip arbiter can hand the router a replica it
+        # provisioned on a borrowed host — and take it back with a drain.
+        # None -> the routes answer 501 (router not arbiter-enabled).
+        self.fleet_adopt_fn = None      # (url: str) -> dict
+        self.fleet_release_fn = None    # (url: str) -> dict
         self.budget = RetryBudget(ratio=retry_budget_ratio)
         self.metrics = RouterMetrics()
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -820,6 +826,9 @@ def _make_handler(router: Router):
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):  # noqa: N802
+            if self.path in ("/fleet/adopt", "/fleet/release"):
+                self._fleet_hook()
+                return
             if self.path != "/predict":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -828,6 +837,33 @@ def _make_handler(router: Router):
             code, headers, payload = router.dispatch(
                 body, self.headers.get("Content-Type", ""))
             self._reply(code, payload, headers=headers)
+
+        def _fleet_hook(self) -> None:
+            """Arbiter control plane: adopt a borrowed-host replica into
+            the fleet / drain it back out. Delegates to hooks the fleet
+            CLI installs; a router without them answers 501."""
+            hook = (router.fleet_adopt_fn if self.path == "/fleet/adopt"
+                    else router.fleet_release_fn)
+            if hook is None:
+                self._reply(501, {"error": "router has no arbiter hooks "
+                                           f"({self.path})"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError as e:
+                self._reply(400, {"error": f"bad JSON body: {e}"})
+                return
+            url = payload.get("url", "")
+            if not url:
+                self._reply(400, {"error": "missing \"url\""})
+                return
+            try:
+                out = hook(url)
+            except Exception as e:  # noqa: BLE001 # vtx: ignore[VTX106] surface hook failure to the arbiter, not a dead socket
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._reply(200, out if isinstance(out, dict) else {"ok": True})
 
     return Handler
 

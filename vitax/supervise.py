@@ -207,6 +207,27 @@ def checkpoint_topology(ckpt_dir: str) -> Optional[int]:
     return int(count) if isinstance(count, int) and count >= 1 else None
 
 
+def topology_env(base_env: dict, process_count: int, process_id: int = 0,
+                 coordinator_port: int = 0) -> dict:
+    """Child environment for one rank of an N-process launch: exactly the
+    bring-up variables vitax/distributed.py reads. Single-process launches
+    get them REMOVED — a stale 2-process JAX_NUM_PROCESSES inherited across
+    an elastic shrink would wedge bring-up waiting on a phantom peer. The
+    canonical builder for every component that relaunches training at a
+    new topology (the arbiter's TrainDirector, the elastic drills)."""
+    env = dict(base_env)
+    for key in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        env.pop(key, None)
+    if process_count > 1:
+        assert coordinator_port > 0, (
+            "multi-process launches need a fresh coordinator port")
+        env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{coordinator_port}"
+        env["JAX_NUM_PROCESSES"] = str(process_count)
+        env["JAX_PROCESS_ID"] = str(process_id)
+    return env
+
+
 def expected_process_count() -> int:
     """The topology the next child launch will run under: the explicit
     bring-up env var (the same one vitax/distributed.py reads), else 0 =
@@ -274,6 +295,15 @@ class Supervisor:
         self.last_exit_code: Optional[int] = None
         self._term_requested = False
         self._term_forwarded = False
+
+    def set_expect_processes(self, n: int) -> None:
+        """Flip the topology the NEXT child launch is expected under — the
+        arbiter's borrow/return path drives this on a supervised
+        deployment. A plain int store (atomic in CPython) read once per
+        restart cycle; resetting _topology_noted makes the next
+        _check_topology announce the change instead of staying quiet."""
+        self.expect_processes = int(n)
+        self._topology_noted = None
 
     # -- signal forwarding ---------------------------------------------------
     def _on_term(self, signum, frame):  # noqa: ARG002 — signal handler signature

@@ -591,6 +591,102 @@ class PeerLiveness:
             return None
 
 
+class ArbiterReporter:
+    """Rank 0's heartbeat to the chip arbiter (--arbiter_url): POST
+    /telemetry with the latest committed step/epoch so borrow policy can
+    gate on "training is actually progressing" instead of inferring it
+    from process liveness.
+
+    One daemon thread; the train loop calls `update()` from its log path
+    (cheap: a lock and three assignments) and the thread posts the latest
+    snapshot every `interval_s`. A snapshot that has not changed is still
+    re-posted every `refresh_s`: a CPU-starved trainer whose steps take
+    longer than the arbiter's staleness window is slow, not stalled, and
+    must not read as a wedged job (the arbiter's dirty-drain rollback is
+    the backstop for the truly wedged case). Transport failures are
+    counted and swallowed — an unreachable arbiter must never slow a
+    step. `http_json` is injectable so tests drive the posting loop with
+    a fake transport and no sockets (same seam style as PeerLiveness)."""
+
+    def __init__(self, arbiter_url: str, process_count: int = 1,
+                 interval_s: float = 2.0, refresh_s: float = 10.0,
+                 http_json: Optional[Callable] = None,
+                 timeout_s: float = 2.0):
+        assert arbiter_url, "ArbiterReporter needs a non-empty arbiter_url"
+        assert interval_s > 0, interval_s
+        assert refresh_s > 0, refresh_s
+        self.url = arbiter_url.rstrip("/") + "/telemetry"
+        self.process_count = int(process_count)
+        self.interval_s = float(interval_s)
+        self.refresh_s = float(refresh_s)
+        self.timeout_s = float(timeout_s)
+        self._http_json = http_json or self._default_http_json
+        self._lock = threading.Lock()
+        # guarded by _lock:
+        self._latest: Optional[dict] = None
+        self._posted: Optional[dict] = None
+        self._last_post_t = 0.0
+        self.posts_total = 0
+        self.post_failures = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_http_json(url: str, payload: dict, timeout: float) -> dict:
+        import json
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.load(resp)
+
+    def update(self, step: int, epoch: int) -> None:
+        """Called from the train loop's log path; never blocks on I/O."""
+        with self._lock:
+            self._latest = {"step": int(step), "epoch": int(epoch),
+                            "process_count": self.process_count}
+
+    def post_once(self, force: bool = False) -> bool:
+        """One delivery attempt of the latest unsent snapshot (the loop
+        body; tests call it directly). True iff something was posted.
+        `force` re-posts an unchanged snapshot — the heartbeat refresh."""
+        with self._lock:
+            latest = self._latest
+            if latest is None or (not force and latest == self._posted):
+                return False
+        try:
+            self._http_json(self.url, latest, self.timeout_s)
+        except Exception:  # noqa: BLE001 — an unreachable arbiter must never hurt training
+            with self._lock:
+                self.post_failures += 1
+            return False
+        with self._lock:
+            self._posted = latest
+            self._last_post_t = time.time()
+            self.posts_total += 1
+        return True
+
+    def start(self) -> None:
+        assert self._thread is None, "reporter already running"
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="vitax-arbiter-report")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                last = self._last_post_t
+            self.post_once(force=time.time() - last >= self.refresh_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s + self.interval_s + 1.0)
+            self._thread = None
+        self.post_once()  # final flush: the last committed step matters most
+
+
 # -- elastic resume (topology change) ----------------------------------------
 
 @dataclasses.dataclass(frozen=True)

@@ -26,7 +26,7 @@ from vitax.config import Config
 from vitax.data import build_datasets
 from vitax.models import build_model, count_params
 from vitax.parallel.mesh import BATCH_AXES, build_mesh
-from vitax.train.control import ControlPlane
+from vitax.train.control import ArbiterReporter, ControlPlane
 from vitax.train.state import TrainState, build_optimizer, make_train_state
 from vitax.train.step import make_eval_step, make_opt_probe, make_train_step
 from vitax.telemetry import (Watchdog, build_recorder,
@@ -255,8 +255,12 @@ def train(cfg: Config) -> TrainState:
     # phase (vitax/train/step.py make_opt_probe), run at log steps only — the
     # train step's program and the non-log-step cadence are untouched. The
     # first probe call warms the compile; timing starts at the second.
+    # Built from cfg.metrics_dir (rank-uniform argv), NOT from the recorder:
+    # the recorder lives on rank 0 only, but the probe is a global-mesh
+    # program — every process must execute it at the same log steps or
+    # rank 0 blocks forever in a collective its peers never enter.
     opt_probe = (make_opt_probe(cfg, tx, mesh, state_specs, schedule=schedule)
-                 if recorder is not None else None)
+                 if (getattr(cfg, "metrics_dir", "") or "") else None)
     opt_probe_warm = [False]
 
     def _time_opt_update(cur_state) -> float:
@@ -353,6 +357,17 @@ def train(cfg: Config) -> TrainState:
                 f"{EXIT_HANG} within the deadline instead of blocking in "
                 f"collectives")
 
+    arbiter_reporter = None
+    if cfg.arbiter_url and jax.process_index() == 0:
+        # chip-arbiter heartbeat (vitax/arbiter/): rank 0 posts the latest
+        # committed step so borrow policy sees live progress. Host-side
+        # thread only — the compiled step program is unchanged.
+        arbiter_reporter = ArbiterReporter(
+            cfg.arbiter_url, process_count=jax.process_count())
+        arbiter_reporter.start()
+        master_print(f"arbiter telemetry: posting step heartbeats to "
+                     f"{cfg.arbiter_url}")
+
     control.warmup()  # compile the agreement fold outside any hang deadline
     distributed.barrier("training begins")
     master_print("training begins (the first few iterations are very slow due to compilation)")
@@ -365,7 +380,8 @@ def train(cfg: Config) -> TrainState:
             resume_step=resume_step, resume_rounded=resume_rounded,
             recorder=recorder, watchdog=watchdog, control=control,
             snap_pipe=snap_pipe, replicator=replicator,
-            opt_timer=_time_opt_update if opt_probe is not None else None)
+            opt_timer=_time_opt_update if opt_probe is not None else None,
+            arbiter_reporter=arbiter_reporter)
     except Exception as e:  # noqa: BLE001 — classify, then exit coordinated or re-raise
         # A dead peer shows up two ways: ICI collectives BLOCK on it (the
         # liveness deadline timer bounds that), host-plane transports like
@@ -389,12 +405,17 @@ def train(cfg: Config) -> TrainState:
             jax.profiler.stop_trace()
             master_print(f"profile trace written to {cfg.profile_dir}")
         control.stop()  # liveness threads + any armed peer-loss exit timer
+        if arbiter_reporter is not None:
+            arbiter_reporter.stop()  # flushes the last committed step
         if watchdog is not None:
             watchdog.stop()  # before the loaders: their drain must not fire it
         train_loader.close()
         val_loader.close()
         if replicator is not None:
-            replicator.stop()  # the receiver thread, not the store
+            # receiver thread + one final guard-shard pull: an elastic
+            # shrink resumes from the survivor's LOCAL store, which must
+            # hold the buddy's preemption-save shard before this exit
+            replicator.stop()
         if snap_pipe is not None:
             snap_pipe.close()  # drain queued persist/replicate jobs
         from vitax.checkpoint.orbax_io import wait_until_finished
@@ -500,7 +521,8 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 schedule, smoothed_loss, smoothed_time, prof,
                 resume_step: int = 0, resume_rounded: bool = False,
                 recorder=None, watchdog=None, control=None,
-                snap_pipe=None, replicator=None, opt_timer=None):
+                snap_pipe=None, replicator=None, opt_timer=None,
+                arbiter_reporter=None):
     if control is None:  # direct callers (tests): a local, collective-free plane
         control = ControlPlane(sync_steps=cfg.control_sync_steps,
                                watchdog=watchdog)
@@ -593,6 +615,13 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                 lr = float(schedule(int(jax.device_get(metrics["lr_step"]))))
                 _run_logging(cfg, epoch, step, host_loss, lr, smoothed_loss,
                              smoothed_time)
+                # fenced re-run of the optimizer phase in isolation (probe
+                # program, not the train step) — the cost rides a log step
+                # that just fenced anyway. Runs on EVERY rank (the probe is
+                # a global-mesh program; its collectives must line up), even
+                # though only rank 0 records the number.
+                opt_update_s = (opt_timer(state)
+                                if opt_timer is not None else 0.0)
                 if recorder is not None:
                     # all inputs are already host values; the one extra
                     # device->host fetch (grad_norm) rides a log step that
@@ -606,13 +635,12 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                         ckpt_stall_s=((snap_pipe.consume_stall_s()
                                        / max(steps_since_record, 1))
                                       if snap_pipe is not None else 0.0),
-                        # fenced re-run of the optimizer phase in isolation
-                        # (probe program, not the train step) — the cost
-                        # rides a log step that just fenced anyway
-                        opt_update_s=(opt_timer(state)
-                                      if opt_timer is not None else 0.0),
+                        opt_update_s=opt_update_s,
                         grad_norm=float(jax.device_get(metrics["grad_norm"])))
                 steps_since_record = 0
+            if arbiter_reporter is not None:
+                # a lock + three assignments; the reporter thread posts
+                arbiter_reporter.update(total_steps, epoch)
             if (replicator is not None and snap_pipe is not None
                     and (step + 1) % cfg.replicate_steps == 0):
                 # replication window: stage this host's shard (the only part
