@@ -1068,3 +1068,61 @@ def test_arbiter_borrow_return_drill(devices8, tmp_path_factory):
     assert peer_restores, [e for e in events if e.get("kind") == "restore"]
     assert all(e["orbax_reads"] == 0 for e in peer_restores)
     assert all(e["resume_step"] > 0 for e in peer_restores)
+
+
+# --- borrowed-host int8 warm boot (PR 19 residue, exercised) -----------------
+
+@pytest.mark.slow
+def test_borrowed_host_boots_int8_npz_replica(devices8, tmp_path):
+    """Warming int8 images on borrowed hosts: the freed host's replica
+    factory (the arbiter's `provision` callback is exactly
+    `agent.provision(model_flags, ...)`) boots a REAL `python -m
+    vitax.serve` replica from a quantized consolidated npz, through the
+    registry's engine constructor (vitax/programs/builder.py:build_engine).
+    The replica warms, and its /metrics pins weights_dtype == "int8" —
+    the borrowed chips hold int8 weights, not a full-precision fallback."""
+    import numpy as np
+    from vitax.checkpoint.consolidate import flatten_tree, save_npz
+    from vitax.config import Config
+    from vitax.models import build_model
+    from vitax.parallel.mesh import build_mesh
+    from vitax.train.state import build_optimizer, make_train_state
+
+    cfg = _drill_tiny_cfg()
+    mesh = build_mesh(cfg)
+    model = build_model(cfg)
+    tx, _ = build_optimizer(cfg, max_iteration=10)
+    import jax
+    state, _, _ = make_train_state(cfg, model, tx, mesh, jax.random.key(0))
+    npz = str(tmp_path / "int8.npz")
+    save_npz(npz, {k: np.asarray(v)
+                   for k, v in flatten_tree(state.params).items()},
+             dtype="int8")
+
+    model_flags = [
+        "--image_size", "16", "--patch_size", "8", "--embed_dim", "32",
+        "--num_heads", "2", "--num_blocks", "2", "--num_classes", "4",
+        "--dtype", "float32", "--serve_max_batch", "4", "--serve_topk", "3",
+        "--max_batch_wait_ms", "10.0",
+        "--npz", npz, "--serve_quant_dtype", "int8",
+    ]
+    agent = PlacementAgent(advertise_host="127.0.0.1",
+                           base_port=free_port(),
+                           manager=ReplicaManager(health_interval_s=0.5,
+                                                  backoff_s=1.0),
+                           max_slots=1)
+    try:
+        out = agent.provision(model_flags, name="borrow_int8")
+        url = out["url"]
+
+        def ready():
+            try:
+                return _http(url + "/healthz", timeout=5.0)["ready"]
+            except Exception:
+                return False
+
+        _wait_for(ready, 240.0, "int8 replica warm")
+        snap = _http(url + "/metrics", timeout=5.0)
+        assert snap["weights_dtype"] == "int8", snap
+    finally:
+        agent.release("borrow_int8")

@@ -35,7 +35,10 @@ for path in vitax/telemetry tools/metrics_report.py \
             tools/autotune.py tools/perf_gate.py presets \
             tests/test_autotune.py \
             vitax/arbiter vitax/arbiter/ledger.py vitax/arbiter/policy.py \
-            vitax/arbiter/daemon.py tests/test_arbiter.py; do
+            vitax/arbiter/daemon.py tests/test_arbiter.py \
+            vitax/programs vitax/programs/registry.py \
+            vitax/programs/builder.py vitax/programs/workloads.py \
+            vitax/parallel/rules.py tests/test_programs.py; do
     if [ ! -e "$path" ]; then
         echo "lint: expected $path to exist (lint/test coverage guard)" >&2
         exit 1
@@ -56,13 +59,14 @@ fi
 
 # compiled-program invariants, fast arm subset (VTX-Rnnn; rules.FAST_ARMS —
 # one train arm exercising R001-R005, the fused-optimizer arm for R008,
-# plus the serve arms: full-precision, int8, fp8 (R006/R007) and the
-# forced-fused act-quant arm for R009.
+# the scenario arms (probe/distill) for R010, plus the serve arms:
+# full-precision, int8, fp8 (R006/R007) and the forced-fused act-quant arm
+# for R009.
 # VITAX_LINT_SKIP_INVARIANTS=1 skips on boxes without the jax toolchain.
 if [ "${VITAX_LINT_SKIP_INVARIANTS:-0}" != "1" ]; then
     python tools/check_invariants.py \
-        --arms zero3_overlap fused serve serve_quant serve_fp8 \
-               serve_actquant || exit 1
+        --arms zero3_overlap fused probe distill serve serve_quant \
+               serve_fp8 serve_actquant || exit 1
 fi
 
 # perf-data schema + compile-only cost-model ranking: validates every

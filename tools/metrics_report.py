@@ -231,6 +231,22 @@ def summarize(path: str) -> dict:
         last = max(evals, key=lambda e: (e.get("epoch", 0), e.get("time", 0)))
         summary["eval_last"] = {k: last.get(k)
                                 for k in ("epoch", "top1", "top5", "n")}
+    # scenario registry (vitax/programs/): finetune warm-start provenance
+    # and the distill loss decomposition at the latest log step
+    fts = [e for e in events if e.get("kind") == "finetune"]
+    if fts:
+        last = max(fts, key=lambda e: e.get("time", 0))
+        summary["finetune_last"] = {
+            k: last.get(k)
+            for k in ("init_npz", "loaded", "reinit", "frozen_frac")}
+    distills = [e for e in events if e.get("kind") == "distill"]
+    if distills:
+        last = max(distills, key=lambda e: (e.get("step", 0),
+                                            e.get("time", 0)))
+        summary["distill_last"] = {
+            k: last.get(k)
+            for k in ("step", "epoch", "kl", "ce", "teacher_top1",
+                      "student_top1", "alpha", "temp")}
     # quantized-serving accuracy gate (vitax/serve/quant.py run_quant_gate):
     # latest quantized-vs-f32 comparison; deltas are in points
     gates = [e for e in events if e.get("kind") == "quant_gate"]
@@ -420,6 +436,20 @@ def print_human(summary: dict) -> None:
     if ev:
         print(f"  eval (epoch {ev['epoch']}): top1 {ev['top1']:.4f}  "
               f"top5 {ev['top5']:.4f}  (n={ev['n']})")
+    ft = summary.get("finetune_last")
+    if ft:
+        reinit = ft.get("reinit") or []
+        print(f"  finetune: {ft['loaded']} leaves from {ft['init_npz']}"
+              + (f", head re-initialized ({len(reinit)} leaves)"
+                 if reinit else "")
+              + (f", frozen frac {ft['frozen_frac']:.3f}"
+                 if ft.get("frozen_frac") else ""))
+    dl = summary.get("distill_last")
+    if dl:
+        print(f"  distill (step {dl['step']}): kl {dl['kl']:.4f}  "
+              f"ce {dl['ce']:.4f}  teacher top1 {dl['teacher_top1']:.4f}  "
+              f"student top1 {dl['student_top1']:.4f}  "
+              f"(alpha {dl['alpha']}, T {dl['temp']})")
     qg = summary.get("quant_gate_last")
     if qg:
         print(f"  quant gate ({qg['weights_dtype']} vs "

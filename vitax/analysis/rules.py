@@ -62,6 +62,12 @@ class Program:
     mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
     n_state_leaves: int = 0
     engine: Any = None
+    # scenario freeze evidence (vitax/programs/builder.py freeze_report,
+    # captured on probe/distill arms): '/'-joined param paths the task
+    # freezes, and the param subpath of every optimizer moment (mu/nu) leaf
+    # that exists in the abstract opt_state — VTX-R010's inputs
+    frozen_paths: Tuple[str, ...] = ()
+    opt_moment_paths: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -479,6 +485,54 @@ def check_fused_dequant(program: Program, cfg: Config) -> List[Finding]:
     return out
 
 
+def _frozen_task(cfg: Config) -> bool:
+    """Config-side gate for VTX-R010: scenarios that freeze parameters."""
+    return getattr(cfg, "task", "train") in ("probe", "distill")
+
+
+@rule("VTX-R010", "frozen-params-not-updated", "ERROR", ("train",),
+      "a scenario that freezes parameters (--task probe: the backbone; "
+      "--task distill: the whole teacher tower) must not give any frozen "
+      "leaf optimizer moments — optax.masked drops masked-out positions to "
+      "leafless MaskedNodes, so a frozen leaf acquiring a mu/nu slot means "
+      "the mask silently stopped covering it and AdamW is stepping a "
+      "'frozen' parameter; distill programs must additionally carry the "
+      "teacher forward under stop_gradient in the traced jaxpr",
+      applies_to=_frozen_task)
+def check_frozen_not_updated(program: Program, cfg: Config) -> List[Finding]:
+    r = FROZEN_NOT_UPDATED
+    out: List[Finding] = []
+    if not program.frozen_paths:
+        out.append(_finding(
+            r, program,
+            "frozen-scenario program carries no frozen-path evidence — "
+            "build_train_program captures freeze_report() on probe/distill "
+            "arms; nothing to audit",
+            task=getattr(cfg, "task", "train")))
+        return out
+    frozen = program.frozen_paths
+    for m in program.opt_moment_paths:
+        if any(m == f or m.startswith(f + "/") for f in frozen):
+            out.append(_finding(
+                r, program,
+                f"optimizer moment exists for frozen leaf {m!r}: the "
+                f"freeze mask does not cover it and AdamW will step it",
+                moment_path=m))
+    if getattr(cfg, "task", "train") == "distill":
+        if not program.jaxpr:
+            out.append(_finding(
+                r, program,
+                "distill arm lowered without a traced-jaxpr artifact — "
+                "the teacher's stop_gradient marker cannot be audited"))
+        elif "stop_gradient" not in program.jaxpr:
+            out.append(_finding(
+                r, program,
+                "distill step's traced jaxpr contains no stop_gradient — "
+                "the teacher tower is not severed from autodiff and "
+                "teacher cotangents may be computed"))
+    return out
+
+
 NO_HOST_TRANSFER = RULES[0]
 DONATION_HONORED = RULES[1]
 COLLECTIVE_DTYPE = RULES[2]
@@ -488,6 +542,7 @@ SERVE_NO_RECOMPILE = RULES[5]
 QUANT_WEIGHTS_RESIDENT = RULES[6]
 FUSED_OPTIMIZER = RULES[7]
 FUSED_DEQUANT = RULES[8]
+FROZEN_NOT_UPDATED = RULES[9]
 
 
 def rules_for(program: Program) -> List[Rule]:
@@ -530,6 +585,11 @@ TRAIN_ARMS: Dict[str, dict] = {
     # forced fused optimizer (interpret-mode Pallas on CPU) — the arm that
     # activates VTX-R008 and captures the traced-jaxpr artifact
     "fused": dict(gather_overlap="off", fused_optimizer="on"),
+    # scenario arms (vitax/programs/registry.py): the probe's masked-frozen
+    # backbone and the distill two-tower step, lowered through the unified
+    # builder (vitax/programs/builder.py) — the arms that activate VTX-R010
+    "probe": dict(task="probe", gather_overlap="off"),
+    "distill": dict(task="distill", gather_overlap="off"),
 }
 
 SERVE_ARM = "serve"
@@ -548,9 +608,10 @@ SERVE_ACTQUANT_ARM = "serve_actquant"
 SERVE_ARMS = (SERVE_ARM, SERVE_QUANT_ARM, SERVE_FP8_ARM, SERVE_ACTQUANT_ARM)
 ALL_ARMS = tuple(TRAIN_ARMS) + SERVE_ARMS
 # the lint.sh / pre-push subset: one train arm covering R001-R005 (the
-# overlap arm applies every train rule), the fused arm for R008, plus the
-# serve arms for R006/R007 (all quant dtypes) and R009 (forced fused)
-FAST_ARMS = ("zero3_overlap", "fused") + SERVE_ARMS
+# overlap arm applies every train rule), the fused arm for R008, the
+# scenario arms for R010, plus the serve arms for R006/R007 (all quant
+# dtypes) and R009 (forced fused)
+FAST_ARMS = ("zero3_overlap", "fused", "probe", "distill") + SERVE_ARMS
 
 
 def arm_config(arm: str, **overrides) -> Config:
@@ -572,18 +633,35 @@ def arm_config(arm: str, **overrides) -> Config:
 
 def build_train_program(cfg: Config, arm: str = "custom",
                         donate: bool = True) -> Program:
-    """Lower the train step for `cfg` and capture the rule artifacts."""
+    """Lower the scenario's step program for `cfg` and capture the rule
+    artifacts. --task train takes the historical hlo.lower_train_step path
+    byte-for-byte (its identity is pinned by tests); other scenarios lower
+    through the unified builder, which additionally captures the
+    freeze-report evidence VTX-R010 reads."""
     from vitax.parallel.mesh import build_mesh
-    lowered, n_state_leaves = hlo.lower_train_step(cfg, donate=donate)
+    task = getattr(cfg, "task", "train")
+    frozen_paths: Tuple[str, ...] = ()
+    opt_moment_paths: Tuple[str, ...] = ()
+    if task == "train":
+        lowered, n_state_leaves = hlo.lower_train_step(cfg, donate=donate)
+        # the traced-jaxpr artifact only exists where a rule reads it
+        jaxpr = hlo.train_step_jaxpr(cfg) if _fused_active(cfg) else ""
+    else:
+        from vitax.programs import builder as B
+        lowered, n_state_leaves = B.lower_step(cfg, donate=donate)
+        frozen_paths, opt_moment_paths = B.freeze_report(cfg)
+        jaxpr = (B.step_jaxpr(cfg)
+                 if (_fused_active(cfg) or task == "distill") else "")
     mesh = build_mesh(cfg)
     return Program(
         kind="train", arm=arm, config=cfg,
         mlir=lowered.as_text(),
         partitioned_hlo=hlo.capture_partitioned(lowered),
-        # the traced-jaxpr artifact only exists where a rule reads it
-        jaxpr=hlo.train_step_jaxpr(cfg) if _fused_active(cfg) else "",
+        jaxpr=jaxpr,
         mesh_shape=dict(mesh.shape),
         n_state_leaves=n_state_leaves,
+        frozen_paths=frozen_paths,
+        opt_moment_paths=opt_moment_paths,
     )
 
 

@@ -267,6 +267,22 @@ class Config:
     serve_batch_max: int = 0            # composed-group size cap (0 = use --serve_max_batch, the
     #   largest engine bucket — bigger groups would split anyway)
 
+    # --- scenario registry (vitax/programs/) ---
+    task: str = "train"                 # which registered scenario this run executes (train /
+    #   finetune / probe / distill); each scenario's validator runs at the
+    #   end of validate() (vitax/programs/registry.py)
+    init_npz: str = ""                  # finetune warm start: consolidated npz export whose params
+    #   overwrite the fresh init leaf-for-leaf (head may re-init)
+    teacher_npz: str = ""               # distillation teacher: consolidated npz export served as the
+    #   frozen eval-mode tower inside the distill step
+    reinit_head: bool = False           # finetune: keep the fresh head init even when the export's
+    #   head shapes match (training a new label space of the same size)
+    backbone_lr_mult: float = 1.0       # finetune: multiply non-head updates by this after AdamW
+    #   (1.0 = off; 0 freezes the backbone — but prefer --task probe, which
+    #   also drops the backbone optimizer moments)
+    distill_alpha: float = 0.5          # distill loss mix: (1-alpha)*CE(labels) + alpha*KL(teacher)
+    distill_temp: float = 2.0           # distill softmax temperature T (KL term scaled by T^2)
+
     @property
     def resolved_param_gather_dtype(self) -> str:
         """Gather-dtype policy after None -> --dtype resolution."""
@@ -577,6 +593,18 @@ class Config:
                 "--grad_reduce_dtype bfloat16 requires the bf16 comm-cast to be "
                 "active (--dtype bfloat16 and param_gather_dtype bfloat16): the "
                 "bf16 reduction rides the cast boundary")
+        assert 0.0 <= self.distill_alpha <= 1.0, (
+            f"--distill_alpha must be in [0, 1] (the CE/KL mix), got "
+            f"{self.distill_alpha}")
+        assert self.distill_temp > 0, (
+            f"--distill_temp must be > 0, got {self.distill_temp}")
+        assert self.backbone_lr_mult >= 0, (
+            f"--backbone_lr_mult must be >= 0, got {self.backbone_lr_mult}")
+        # scenario dispatch: each --task's pairwise flag checks live with its
+        # registry entry (vitax/programs/registry.py), not here — this
+        # validator stops accreting per-workload blocks
+        from vitax.programs.registry import get_scenario
+        get_scenario(self.task).validate(self)
         return self
 
 
@@ -869,6 +897,43 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--serve_batch_max", type=int, default=0,
                        help="composed-group size cap "
                             "(0 = --serve_max_batch)")
+
+    # scenario registry (vitax/programs/registry.py)
+    scen = parser.add_argument_group("vitax scenarios (vitax/programs/)")
+    scen.add_argument("--task", type=str, default="train",
+                      choices=["train", "finetune", "probe", "distill"],
+                      help="which registered scenario to run: "
+                           "train = reference pretraining (CE over labels); "
+                           "finetune = warm start from --init_npz with the "
+                           "head re-initialized for a new --num_classes "
+                           "(--reinit_head / shape mismatch) and optional "
+                           "--backbone_lr_mult; "
+                           "probe = linear probe — backbone frozen via "
+                           "optax masking, optimizer moments exist for the "
+                           "head only; "
+                           "distill = knowledge distillation — frozen "
+                           "teacher (--teacher_npz) and student in ONE "
+                           "jitted program, loss (1-alpha)*CE + alpha*KL "
+                           "at --distill_temp")
+    scen.add_argument("--init_npz", type=str, default="",
+                      help="finetune/probe warm start: consolidated npz "
+                           "export (vitax.checkpoint.consolidate) loaded "
+                           "into the fresh sharded state")
+    scen.add_argument("--teacher_npz", type=str, default="",
+                      help="distillation teacher: consolidated npz export "
+                           "(quantized exports dequantize to f32 for the "
+                           "teacher forward)")
+    scen.add_argument("--reinit_head", action="store_true",
+                      dest="reinit_head",
+                      help="finetune: keep the fresh head init even when "
+                           "the export's head shapes match")
+    scen.add_argument("--backbone_lr_mult", type=float, default=1.0,
+                      help="finetune: scale non-head updates by this after "
+                           "AdamW (1.0 = off)")
+    scen.add_argument("--distill_alpha", type=float, default=0.5,
+                      help="distill loss mix: (1-alpha)*CE + alpha*KL")
+    scen.add_argument("--distill_temp", type=float, default=2.0,
+                      help="distill softmax temperature (KL scaled by T^2)")
     return parser
 
 

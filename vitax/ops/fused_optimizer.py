@@ -75,7 +75,18 @@ def fused_optimizer_active(cfg) -> bool:
     real Mosaic (TPU backend, or VITAX_FORCE_MOSAIC=1 for AOT TPU-target
     compiles) — mirroring the attention kernels' `_interpret()` policy, so
     default CPU programs stay on the reference optax chain. `on` forces the
-    fused path anywhere (interpret mode off-TPU — the CI equivalence arms)."""
+    fused path anywhere (interpret mode off-TPU — the CI equivalence arms).
+
+    Scenario exemptions (vitax/programs/registry.py): the fused kernel
+    bypasses the optax chain and steps EVERY leaf at the schedule lr, so it
+    cannot express the probe's masked-frozen backbone or the finetune
+    backbone-lr multiplier — those tasks stay on optax regardless of mode
+    (their validators reject an explicit `on`)."""
+    task = getattr(cfg, "task", "train")
+    if task == "probe":
+        return False
+    if task == "finetune" and getattr(cfg, "backbone_lr_mult", 1.0) != 1.0:
+        return False
     mode = getattr(cfg, "fused_optimizer", "auto")
     if mode == "off":
         return False

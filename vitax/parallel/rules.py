@@ -1,0 +1,185 @@
+"""Declarative tree-path sharding rules: ordered regex -> PartitionSpec table.
+
+This is the scalax `TreePathShardingRule` shape (SNIPPETS.md §1-3) applied to
+vitax's mesh: each parameter's "/"-joined tree path is matched against an
+ORDERED rule table (first match wins, strict — an unmatched path raises), and
+the matching rule names the structural placement class:
+
+- COLUMN  Megatron column-parallel: output dim (ndim-1) over "tp" (qkv, fc1 —
+          kernel AND bias, a bias's only dim is its output dim)
+- ROW     Megatron row-parallel: input dim (ndim-2) over "tp" (attn proj / fc2
+          kernels only; their biases follow the default rule)
+- EXPERT  GShard expert weights: the (E, ...) experts dim over "ep"
+- None    default dense leaf: no tp/ep placement
+
+On top of the matched class the resolver applies the placements that are
+shape/mesh-dependent and therefore cannot live in a static table:
+
+- the scanned stacked-layers dim (dim 0 of `blocks` leaves under
+  --scan_blocks) goes to "pp" when pipelined and is otherwise never sharded;
+- ZeRO-3 puts "fsdp" on the largest remaining dim divisible by the axis size.
+
+The table + resolver reproduce `parallel/sharding.py:param_pspec` exactly —
+pinned leaf-for-leaf across the dp/zero2/zero3/tp/pp/ep arms by
+tests/test_programs.py. `param_pspec` stays as the reference dispatcher the
+pin compares against; live spec construction (`sharding.param_specs`) routes
+through this table.
+
+Scalar exemption (scalax idiom): 0-dim and total-size-1 leaves skip matching
+entirely and replicate — there is nothing to shard and no rule to demand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Optional, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from vitax.config import Config
+
+PyTree = Any
+
+# mesh axis order every (dp, fsdp, tp, sp, pp, ep) tuple in this module uses
+MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+# placement classes a rule can declare
+COLUMN = "column"   # "tp" on the output dim (ndim-1)
+ROW = "row"         # "tp" on the input dim (ndim-2)
+EXPERT = "expert"   # "ep" on the experts dim (first shardable dim)
+
+
+@dataclasses.dataclass(frozen=True)
+class PathRule:
+    """One ordered table entry: regex over the '/'-joined param path."""
+    name: str
+    pattern: str
+    placement: Optional[str] = None  # COLUMN | ROW | EXPERT | None
+
+    def matches(self, path: str) -> bool:
+        return re.search(self.pattern, path) is not None
+
+
+# Ordered: first match wins. The final entry is NOT a catch-all — it
+# enumerates the generic dense leaf names (kernel/bias/scale/pos_embed), so a
+# new parameter class fails loudly here instead of silently replicating.
+RULE_TABLE: Tuple[PathRule, ...] = (
+    PathRule("moe-expert-weights",
+             r"(^|/)moe/(?:.*/)?(w1|b1|w2|b2)$", EXPERT),
+    PathRule("megatron-column-qkv-fc1",
+             r"(^|/)(qkv|fc1)(/|$)", COLUMN),
+    PathRule("megatron-row-attn-proj",
+             r"(^|/)attn/(?:.*/)?proj/kernel$", ROW),
+    PathRule("megatron-row-fc2",
+             r"(^|/)fc2/kernel$", ROW),
+    PathRule("dense-default",
+             r"(^|/)(kernel|bias|scale|pos_embed|embedding)$", None),
+)
+
+
+def match_rule(path: str, table: Tuple[PathRule, ...] = RULE_TABLE) -> PathRule:
+    """First matching rule for a '/'-joined param path; strict (raises)."""
+    for r in table:
+        if r.matches(path):
+            return r
+    raise ValueError(f"Partition rule not found for param: {path}")
+
+
+def _leaf_path_names(path) -> Tuple[str, ...]:
+    # jax KeyPath entries -> plain names (same shape as sharding._path_names;
+    # duplicated here so rules.py stays below sharding.py in the import graph)
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "name"):
+            names.append(str(p.name))
+        elif hasattr(p, "idx"):
+            names.append(str(p.idx))
+        else:
+            names.append(str(p))
+    return tuple(names)
+
+
+def rule_pspec(
+    names: Tuple[str, ...],
+    shape: Tuple[int, ...],
+    cfg: Config,
+    mesh_shape: Tuple[int, ...],  # (dp, fsdp, tp, sp, pp, ep)
+    scanned: bool,
+    table: Tuple[PathRule, ...] = RULE_TABLE,
+) -> P:
+    """Resolve one parameter's PartitionSpec from the rule table."""
+    _, fsdp, tp, _, pp, ep = mesh_shape
+    ndim = len(shape)
+
+    # scalar exemption: nothing to shard, no rule required
+    if ndim == 0 or math.prod(shape) == 1:
+        return P(*([None] * ndim))
+
+    rule = match_rule("/".join(names), table)
+    spec: list = [None] * ndim
+
+    is_scanned_block = scanned and "blocks" in names
+    first_shardable = 1 if is_scanned_block else 0
+
+    if pp > 1 and is_scanned_block:
+        assert shape[0] % pp == 0, (
+            f"pp: stacked layer dim {shape[0]} of {names} not divisible by "
+            f"pp={pp}")
+        spec[0] = "pp"
+
+    if ep > 1 and rule.placement == EXPERT:
+        e_dim = first_shardable
+        assert shape[e_dim] % ep == 0, (
+            f"ep: experts dim {e_dim} of {names} {shape} not divisible by "
+            f"ep={ep}")
+        spec[e_dim] = "ep"
+        first_shardable = e_dim + 1
+
+    if tp > 1 and rule.placement in (COLUMN, ROW):
+        tp_dim = ndim - 1 if rule.placement == COLUMN else ndim - 2
+        if tp_dim >= first_shardable:
+            assert shape[tp_dim] % tp == 0, (
+                f"TP: dim {tp_dim} of {names} {shape} not divisible by tp={tp}")
+            spec[tp_dim] = "tp"
+
+    if fsdp > 1 and not cfg.run_without_fsdp:
+        # largest free dim divisible by the fsdp axis (ZeRO-3); small
+        # indivisible params stay replicated
+        candidates = [
+            (shape[d], d) for d in range(first_shardable, ndim)
+            if spec[d] is None and shape[d] % fsdp == 0 and shape[d] >= fsdp
+        ]
+        if candidates:
+            _, d = max(candidates)
+            spec[d] = "fsdp"
+
+    return P(*spec)
+
+
+def specs_from_rules(
+    abstract_params: PyTree,
+    cfg: Config,
+    mesh,
+    table: Tuple[PathRule, ...] = RULE_TABLE,
+) -> PyTree:
+    """PartitionSpec tree for an (abstract) param tree via the rule table."""
+    import jax
+
+    mesh_shape = tuple(mesh.shape[a] for a in MESH_AXES)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: rule_pspec(
+            _leaf_path_names(path), leaf.shape, cfg, mesh_shape,
+            cfg.scan_blocks, table),
+        abstract_params,
+    )
+
+
+def describe_table(table: Tuple[PathRule, ...] = RULE_TABLE) -> str:
+    """Human-readable rule table (README / debugging)."""
+    rows = [f"  {r.name:28s} {r.pattern:44s} -> {r.placement or 'default'}"
+            for r in table]
+    return "\n".join(rows)

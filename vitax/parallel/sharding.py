@@ -144,12 +144,15 @@ def param_pspec(
 
 
 def param_specs(abstract_params: PyTree, cfg: Config, mesh: Mesh) -> PyTree:
-    """PartitionSpec tree matching an (abstract) parameter tree."""
-    mesh_shape = tuple(mesh.shape[a] for a in ("dp", "fsdp", "tp", "sp", "pp", "ep"))
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: param_pspec(path, leaf.shape, cfg, mesh_shape, cfg.scan_blocks),
-        abstract_params,
-    )
+    """PartitionSpec tree matching an (abstract) parameter tree.
+
+    Routed through the declarative rule table (vitax/parallel/rules.py,
+    scalax `TreePathShardingRule` style). `param_pspec` above remains the
+    reference dispatcher the table is pinned against leaf-for-leaf across
+    the dp/zero2/zero3/tp/pp/ep arms (tests/test_programs.py)."""
+    from vitax.parallel import rules as _rules
+
+    return _rules.specs_from_rules(abstract_params, cfg, mesh)
 
 
 def state_specs_like(abstract_state: PyTree, params_specs: PyTree) -> PyTree:

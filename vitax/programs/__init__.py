@@ -1,0 +1,20 @@
+"""Scenario registry + unified program builder (ROADMAP item 3).
+
+`registry` maps --task names to Scenario declarations (programs, optimizer,
+validator, sharding rules); `builder` turns (task, geometry) into jitted/AOT
+programs through a shared compile cache; `workloads` holds the finetune /
+linear-probe / distillation ingredients the scenarios are spent on.
+"""
+
+from vitax.programs.registry import SCENARIOS, TASKS, Scenario, get_scenario
+
+__all__ = [
+    "SCENARIOS",
+    "TASKS",
+    "Scenario",
+    "get_scenario",
+    # heavy (jax-importing) surfaces are reached via their modules:
+    #   vitax.programs.builder   Geometry, build_program, build_engine,
+    #                            lower_step, step_jaxpr, freeze_report
+    #   vitax.programs.workloads masks, optimizers, warm starts, distill step
+]

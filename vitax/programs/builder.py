@@ -1,0 +1,270 @@
+"""Unified program builder: one `build_program(task, geometry)` entry.
+
+ROADMAP item 3's first half. The three independent assembly paths —
+train/loop.py hand-wiring mesh+model+optimizer+step, analysis/hlo.py
+rebuilding the same stack for the AOT surfaces, serve/engine.py assembling
+its own forward — converge here:
+
+- `Geometry` is the shared substrate (cfg, mesh, model, optimizer, schedule,
+  state specs) every program is built against. The training loop constructs
+  its geometry from live objects (non-owned: nothing cached, programs bound
+  to the loop's exact model/optimizer — the lowered bytes are pinned
+  identical to the pre-builder direct calls); analysis/tools call
+  `Geometry.from_config(cfg)`, which memoizes (owned) so an arm's lower +
+  jaxpr + freeze-report probes share one traced stack instead of three.
+- `build_program(task, geom)` dispatches to the per-task constructors
+  (train/train/step.py, eval, opt_probe, distill in programs/workloads.py,
+  serve buckets on an InferenceEngine) and caches built programs per owned
+  geometry — the shared compile cache.
+- `build_engine(cfg, ...)` is the registry's engine constructor: every CLI
+  that boots a serving engine (vitax.serve.__main__, arbiter-provisioned
+  replicas) routes through it, so scenario validation runs before any
+  checkpoint IO.
+
+The scenario registry (programs/registry.py) names which tasks each --task
+may build; unknown combinations fail here with the scenario's program set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from vitax.config import Config
+from vitax.programs.registry import Scenario, get_scenario
+
+PyTree = Any
+
+# program kinds build_program understands (each scenario declares a subset)
+PROGRAM_KINDS = ("train", "eval", "opt_probe", "distill", "serve_bucket")
+
+
+@dataclasses.dataclass
+class Geometry:
+    """Everything a program is built against: the resolved mesh/model/
+    optimizer/spec stack for one Config. `owned=True` (Geometry.from_config)
+    marks a geometry the builder materialized itself — those carry the
+    abstract state for AOT lowering and participate in the program cache.
+    Loop-constructed geometries wrap live objects and cache nothing."""
+    cfg: Config
+    mesh: Any
+    model: Any
+    tx: Any
+    schedule: Any
+    state_specs: PyTree
+    abstract_state: Optional[PyTree] = None   # ShapeDtypeStruct TrainState
+    max_iteration: int = 10_000
+    owned: bool = False
+    _programs: Dict[Tuple, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def scenario(self) -> Scenario:
+        return get_scenario(self.cfg.task)
+
+    @classmethod
+    def from_config(cls, cfg: Config, max_iteration: int = 10_000) -> "Geometry":
+        """Materialize the full (abstract) stack for one Config — the exact
+        assembly the training loop performs (train/loop.py:166-182), shared
+        by the analysis arms and AOT tools. Memoized per (cfg, max_iteration)
+        so one arm's multiple probes trace the stack once."""
+        key = (dataclasses.astuple(cfg), max_iteration)
+        hit = _GEOMETRY_CACHE.get(key)
+        if hit is not None:
+            return hit
+
+        import jax
+        from vitax.models import build_model
+        from vitax.ops.attention import make_attention_impl
+        from vitax.parallel.mesh import build_mesh
+        from vitax.train.loop import _moe_dispatch_sharding, _token_sharding
+        from vitax.train.state import make_train_state
+
+        mesh = build_mesh(cfg)
+        model = build_model(
+            cfg, attention_impl=make_attention_impl(cfg, mesh),
+            token_sharding=_token_sharding(cfg, mesh),
+            moe_dispatch_sharding=_moe_dispatch_sharding(cfg, mesh))
+        tx, schedule = get_scenario(cfg.task).make_optimizer(
+            cfg, max_iteration)
+        abstract, sspecs, _ = make_train_state(
+            cfg, model, tx, mesh, jax.random.key(cfg.seed),
+            materialize=False)
+        geom = cls(cfg=cfg, mesh=mesh, model=model, tx=tx, schedule=schedule,
+                   state_specs=sspecs, abstract_state=abstract,
+                   max_iteration=max_iteration, owned=True)
+        _GEOMETRY_CACHE[key] = geom
+        return geom
+
+
+# owned geometries, memoized by (cfg fields, max_iteration) — Config is a
+# flat dataclass of scalars/strings, so astuple is hashable
+_GEOMETRY_CACHE: Dict[Tuple, Geometry] = {}
+
+
+def build_program(task: str, geom: Geometry, donate: bool = True,
+                  bucket: Optional[int] = None, engine=None):
+    """Build (or fetch from the owned-geometry cache) one program.
+
+    task        one of PROGRAM_KINDS, and a member of the scenario's declared
+                program set (registry.py) — the registry is the contract for
+                what each --task may assemble
+    donate      train/distill only: donate the state buffers (production);
+                False builds the analysis negative arm
+    bucket      serve_bucket only: the batch bucket to lower
+    engine      serve_bucket only: the InferenceEngine holding the params
+                (serve programs are bound to concrete weights, not abstract
+                geometry — build one with build_engine)
+    """
+    scenario = geom.scenario
+    if task not in PROGRAM_KINDS:
+        raise ValueError(
+            f"unknown program kind {task!r}; builder knows {PROGRAM_KINDS}")
+    if task not in scenario.programs:
+        raise ValueError(
+            f"--task {scenario.name} does not build {task!r} programs "
+            f"(declared set: {scenario.programs}; vitax/programs/registry.py)")
+
+    key = (task, donate, bucket)
+    if geom.owned and key in geom._programs:
+        return geom._programs[key]
+
+    cfg, mesh, model = geom.cfg, geom.mesh, geom.model
+    if task == "train":
+        from vitax.train.step import make_train_step
+        program = make_train_step(cfg, model, geom.tx, mesh,
+                                  geom.state_specs, donate=donate,
+                                  schedule=geom.schedule)
+    elif task == "eval":
+        from vitax.train.step import make_eval_step
+        program = make_eval_step(cfg, model, mesh, geom.state_specs)
+    elif task == "opt_probe":
+        from vitax.train.step import make_opt_probe
+        program = make_opt_probe(cfg, geom.tx, mesh, geom.state_specs,
+                                 schedule=geom.schedule)
+    elif task == "distill":
+        from vitax.programs.workloads import (load_teacher_params,
+                                              make_distill_step)
+        if cfg.teacher_npz:
+            teacher = load_teacher_params(cfg, mesh)
+        else:
+            # no file: lower against the ABSTRACT teacher (analysis arms,
+            # AOT probes) — requires an owned geometry's abstract state
+            assert geom.abstract_state is not None, (
+                "--task distill needs --teacher_npz to build a runnable "
+                "program (abstract lowering needs Geometry.from_config)")
+            teacher = geom.abstract_state.params
+        program = make_distill_step(cfg, model, geom.tx, mesh,
+                                    geom.state_specs, teacher,
+                                    donate=donate, schedule=geom.schedule)
+    else:  # serve_bucket
+        assert engine is not None and bucket is not None, (
+            "serve_bucket programs are built on an InferenceEngine: pass "
+            "engine=build_engine(cfg, ...) and bucket=<batch size>")
+        lowered, _ = engine._lower_bucket(bucket)
+        program = lowered
+
+    if geom.owned:
+        geom._programs[key] = program
+    return program
+
+
+def build_engine(cfg: Config, npz: str = "", epoch: Optional[int] = None):
+    """The registry's serving-engine constructor: scenario-checked, then the
+    engine source is picked exactly like vitax.serve.__main__ historically
+    did — a consolidated npz export (quantized exports load their int8
+    leaves as int8, the arbiter's warm-on-borrowed-host path) or the latest/
+    requested Orbax epoch checkpoint."""
+    scenario = get_scenario(cfg.task)
+    assert "serve_bucket" in scenario.programs, (
+        f"--task {scenario.name} declares no serving programs "
+        f"(vitax/programs/registry.py)")
+    from vitax.serve.engine import InferenceEngine
+    if npz:
+        return InferenceEngine.from_npz(cfg, npz)
+    return InferenceEngine.from_checkpoint(cfg, cfg.ckpt_dir, epoch)
+
+
+# --- AOT / analysis surfaces -------------------------------------------------
+# Scenario-aware mirrors of analysis/hlo.py's lower_train_step family: the
+# invariant arms for --task probe/distill lower through these. hlo.py's own
+# builders are untouched — the train-task identity pins compare against them.
+
+
+def _build_step(cfg: Config, max_iteration: int, donate: bool):
+    """(step, (state, batch, rng) abstract args, n_state_leaves) for the
+    scenario's step program — the same return contract as
+    analysis/hlo.py:_build_train_step, for any --task."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from vitax.parallel.mesh import batch_pspec
+
+    geom = Geometry.from_config(cfg, max_iteration=max_iteration)
+    step = build_program(geom.scenario.step_program, geom, donate=donate)
+    sh = NamedSharding(geom.mesh, batch_pspec())
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.image_size, cfg.image_size, 3),
+            jnp.float32, sharding=sh),
+        "label": jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32,
+                                      sharding=sh),
+    }
+    args = (geom.abstract_state, batch, jax.random.key(cfg.seed + 1))
+    return step, args, len(jax.tree_util.tree_leaves(geom.abstract_state))
+
+
+def lower_step(cfg: Config, max_iteration: int = 10_000, donate: bool = True):
+    """AOT-lower the scenario's step program; returns
+    (lowered, n_state_leaves) like hlo.lower_train_step."""
+    step, args, n_state_leaves = _build_step(cfg, max_iteration, donate)
+    return step.lower(*args), n_state_leaves
+
+
+def step_jaxpr(cfg: Config, max_iteration: int = 10_000) -> str:
+    """Traced jaxpr text of the scenario's step program (the VTX-R008 /
+    VTX-R010 artifact — stop_gradient and pallas_call markers survive only
+    here, not in StableHLO)."""
+    step, args, _ = _build_step(cfg, max_iteration, donate=True)
+    return str(step.trace(*args).jaxpr)
+
+
+def freeze_report(cfg: Config,
+                  max_iteration: int = 10_000) -> Tuple[Tuple[str, ...],
+                                                        Tuple[str, ...]]:
+    """(frozen_param_paths, optimizer_moment_paths) for the scenario, read
+    off the ABSTRACT state — the VTX-R010 evidence.
+
+    frozen paths: '/'-joined param-tree paths the scenario freezes ("head"
+    excluded for probe; every teacher leaf, prefixed "teacher/", for
+    distill). moment paths: the param subpath of every mu/nu leaf that
+    EXISTS in the optimizer state — optax.masked replaces masked-out
+    positions with leafless MaskedNodes, so a frozen leaf acquiring moments
+    shows up here as a path collision."""
+    import jax
+    from vitax.parallel.rules import _leaf_path_names
+
+    geom = Geometry.from_config(cfg, max_iteration=max_iteration)
+    param_paths = [
+        "/".join(_leaf_path_names(path))
+        for path, _ in jax.tree_util.tree_leaves_with_path(
+            geom.abstract_state.params)
+    ]
+
+    task = cfg.task
+    if task == "probe":
+        frozen = tuple(p for p in param_paths
+                       if "head" not in p.split("/"))
+    elif task == "distill":
+        frozen = tuple("teacher/" + p for p in param_paths)
+    else:
+        frozen = ()
+
+    moments = []
+    for path, _ in jax.tree_util.tree_leaves_with_path(
+            geom.abstract_state.opt_state):
+        names = _leaf_path_names(path)
+        for marker in ("mu", "nu"):
+            if marker in names:
+                moments.append("/".join(names[names.index(marker) + 1:]))
+                break
+    return frozen, tuple(sorted(set(moments)))

@@ -1,0 +1,328 @@
+"""Workload ingredients for the scenario registry (vitax/programs/registry.py).
+
+Three things live here, shared by the builder and the training loop:
+
+- masked optimizers: the probe's frozen backbone (updates set_to_zero, so
+  AdamW moments exist for the HEAD ONLY — `optax.masked` replaces masked-out
+  leaves with leafless MaskedNodes, so the opt_state tree itself shrinks)
+  and the finetune backbone-lr multiplier (a masked `optax.scale` appended
+  AFTER AdamW: a true lr multiplier on the final update, with no state);
+- `warm_start_from_npz`: consolidated single-file export -> the live sharded
+  TrainState, through the same flatten/unflatten key convention serving uses
+  (vitax/checkpoint/consolidate.py), with head re-init for a new
+  --num_classes and loud failure on any other key/shape mismatch;
+- `make_distill_step`: the first program that needs both halves of the stack
+  — a frozen engine-style teacher forward and the student train step — in
+  ONE jitted program. Teacher params enter as an extra NON-donated argument
+  at the student's param shardings; teacher logits sit under stop_gradient
+  (VTX-R010 reads the marker off the traced jaxpr).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from vitax.config import Config
+from vitax.parallel.mesh import Mesh, batch_pspec
+from vitax.parallel.rules import _leaf_path_names
+from vitax.parallel.sharding import make_comm_precision, shardings_of
+from vitax.train.schedule import warmup_cosine_schedule
+from vitax.train.state import ADAMW_HPARAMS, TrainState, build_optimizer
+from vitax.train.step import (_forward_fn, _make_logits_anchor,
+                              _make_update_fn, _needs_dropout, prepare_images)
+from vitax.utils.logging import master_print
+
+PyTree = Any
+
+# the classifier head's module name in the param tree (vitax/models/vit.py):
+# the one partition every transfer workload splits on
+HEAD_NAME = "head"
+
+
+def _is_head(path) -> bool:
+    return HEAD_NAME in _leaf_path_names(path)
+
+
+def head_mask(params: PyTree) -> PyTree:
+    """Bool tree: True on classifier-head leaves."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: _is_head(p), params)
+
+
+def backbone_mask(params: PyTree) -> PyTree:
+    """Bool tree: True on every non-head (backbone) leaf."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: not _is_head(p), params)
+
+
+def frozen_fraction(params: PyTree) -> float:
+    """Fraction of parameter ELEMENTS in the backbone (the frozen partition
+    under --task probe) — the frozen-frac the telemetry events report."""
+    frozen = total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        n = int(jnp.size(leaf)) if hasattr(leaf, "size") else 0
+        total += n
+        if not _is_head(path):
+            frozen += n
+    return frozen / total if total else 0.0
+
+
+# --- optimizers --------------------------------------------------------------
+
+
+def make_finetune_optimizer(cfg: Config, max_iteration: int):
+    """The train optimizer, plus a masked `optax.scale(backbone_lr_mult)`
+    appended when the multiplier != 1: scaling AFTER AdamW multiplies the
+    final update (a true per-partition lr), and `scale` carries no state, so
+    the opt_state tree — and with it state_specs, checkpoints, donation —
+    matches the train task's exactly."""
+    tx, schedule = build_optimizer(cfg, max_iteration)
+    if cfg.backbone_lr_mult != 1.0:
+        tx = optax.chain(
+            tx, optax.masked(optax.scale(cfg.backbone_lr_mult),
+                             backbone_mask))
+    return tx, schedule
+
+
+def make_probe_optimizer(cfg: Config, max_iteration: int):
+    """Linear-probe optimizer: backbone updates zeroed, AdamW over the head
+    ONLY.
+
+    Mirrors build_optimizer's chain shape (identity in the clip's historical
+    slot — the clip itself is applied in the step off the shared grad-norm
+    reduction, vitax/train/step.py:_make_update_fn), with the AdamW wrapped
+    in `optax.masked(head_mask)`: masked-out leaves become leafless
+    MaskedNodes in the opt_state, so the moments tree holds head leaves only
+    (tests/test_programs.py pins this by tree inspection) and state_specs /
+    donation follow with no extra rules. `set_to_zero` runs FIRST: masked
+    transforms pass unmasked updates through untouched, so backbone grads
+    reach `optax.apply_updates` as exact zeros — params stay bitwise-frozen
+    (x + 0.0 is bitwise-identity for every value the init produces)."""
+    schedule = warmup_cosine_schedule(cfg.lr, cfg.warmup_steps, max_iteration)
+    parts = []
+    if cfg.clip_grad_norm > 0:
+        parts.append(optax.identity())
+    parts.append(optax.masked(optax.set_to_zero(), backbone_mask))
+    parts.append(optax.masked(
+        optax.adamw(schedule, weight_decay=cfg.weight_decay,
+                    **ADAMW_HPARAMS),
+        head_mask))
+    return optax.chain(*parts), schedule
+
+
+# --- finetune warm start -----------------------------------------------------
+
+
+def warm_start_from_npz(cfg: Config, state: TrainState,
+                        mesh: Mesh) -> Tuple[TrainState, Dict[str, Any]]:
+    """Overwrite a freshly-initialized sharded TrainState's params from a
+    consolidated npz export (--init_npz), leaf by leaf.
+
+    - Non-head leaves MUST match by key and shape (quantized exports are
+      dequantized to f32 by load_npz; values are cast to the fresh leaf's
+      dtype). A missing key, a shape mismatch, or an unknown export key is
+      a hard error — silently training from a half-loaded tree is the
+      failure mode this loudness exists for.
+    - Head leaves keep their fresh initialization when --reinit_head is set
+      or the export's shape disagrees (a new --num_classes); otherwise they
+      load like everything else.
+    - The optimizer state is left at its fresh init: AdamW moments are
+      zeros + a step count, value-independent, so the fresh born-sharded
+      init IS the correct warm-start opt state.
+
+    Returns (state, info) where info is the kind:"finetune" telemetry
+    payload (loaded/reinit key counts, frozen fraction, source path)."""
+    from vitax.checkpoint.consolidate import (flatten_tree, load_npz,
+                                              unflatten_tree)
+    from vitax.parallel.sharding import param_specs
+
+    flat_npz = load_npz(cfg.init_npz)
+    flat_fresh = flatten_tree(state.params)
+    # flatten_tree np.asarray()s its leaves, which would destroy sharding
+    # objects — walk the spec tree by path with the same key convention
+    flat_shard = {
+        "/".join(_leaf_path_names(path)): NamedSharding(mesh, spec)
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            param_specs(state.params, cfg, mesh),
+            is_leaf=lambda x: isinstance(x, P))[0]}
+
+    unknown = sorted(set(flat_npz) - set(flat_fresh))
+    if unknown:
+        raise ValueError(
+            f"--init_npz {cfg.init_npz} carries keys absent from this "
+            f"model: {unknown[:5]}{'...' if len(unknown) > 5 else ''} — "
+            f"the export was consolidated from a different architecture "
+            f"(check the model shape flags)")
+
+    new_flat, loaded, reinit = {}, [], []
+    for key, fresh in flat_fresh.items():
+        src = flat_npz.get(key)
+        is_head = HEAD_NAME in key.split("/")
+        if is_head and (cfg.reinit_head or src is None
+                        or tuple(src.shape) != tuple(fresh.shape)):
+            reinit.append(key)
+            # keep the fresh head init (flatten_tree coerced it to numpy;
+            # put it back at its sharding)
+            new_flat[key] = jax.device_put(fresh, flat_shard[key])
+            continue
+        if src is None:
+            raise ValueError(
+                f"--init_npz {cfg.init_npz} is missing param {key!r}: a "
+                f"partial export cannot warm-start a finetune (re-export "
+                f"with vitax.checkpoint.consolidate --params_only)")
+        if tuple(src.shape) != tuple(fresh.shape):
+            raise ValueError(
+                f"--init_npz {cfg.init_npz} param {key!r} has shape "
+                f"{tuple(src.shape)}, model expects {tuple(fresh.shape)} "
+                f"(only the head may differ — pass --reinit_head for a "
+                f"new --num_classes)")
+        new_flat[key] = jax.device_put(src.astype(fresh.dtype),
+                                       flat_shard[key])
+        loaded.append(key)
+
+    state = state.replace(params=unflatten_tree(new_flat))
+    info = {
+        "init_npz": cfg.init_npz,
+        "loaded": len(loaded),
+        "reinit": sorted(reinit),
+        "frozen_frac": (frozen_fraction(state.params)
+                        if cfg.task == "probe" else 0.0),
+    }
+    master_print(
+        f"warm start: {info['loaded']} leaves from {cfg.init_npz}"
+        + (f", head re-initialized ({len(reinit)} leaves)" if reinit else ""))
+    return state, info
+
+
+def load_teacher_params(cfg: Config, mesh: Mesh) -> PyTree:
+    """Teacher tree for --task distill: consolidated npz (--teacher_npz,
+    dequantized to f32 — the teacher forward is full-precision compute),
+    device_put into the same param_specs layout the student uses, so the
+    two towers share one sharding story inside the jitted program."""
+    from vitax.checkpoint.consolidate import load_npz, unflatten_tree
+    from vitax.parallel.sharding import param_specs
+
+    params = unflatten_tree(load_npz(cfg.teacher_npz))
+    shardings = shardings_of(mesh, param_specs(params, cfg, mesh))
+    master_print(f"distill: teacher params from {cfg.teacher_npz}")
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+# --- distillation step -------------------------------------------------------
+
+
+def make_distill_step(cfg: Config, model, tx, mesh: Mesh, state_specs: PyTree,
+                      teacher_params: PyTree, donate: bool = True,
+                      schedule=None):
+    """Jitted distillation step: (state, batch, rng) -> (state, metrics),
+    with the frozen teacher closed in as a non-donated program argument.
+
+    Mirrors make_train_step's structure (vitax/train/step.py) minus the
+    paths the distill validator forbids (pp / grad-accum / MoE / ZeRO-2):
+    shared forward assembly, shared optimizer phase (_make_update_fn, one
+    grad-norm reduction feeding clip + metric), same donation and sharding
+    story for the student state. The teacher half is the engine-style
+    eval-mode forward (det=True) under jax.lax.stop_gradient — no teacher
+    grads, no teacher optimizer state, and the marker VTX-R010 greps the
+    traced jaxpr for.
+
+    Loss: (1 - alpha) * CE(student, labels)
+          + alpha * T^2 * KL(softmax(teacher/T) || softmax(student/T))
+    (Hinton et al.; the T^2 factor keeps the soft-target gradient scale
+    comparable across temperatures).
+    """
+    state_shardings = shardings_of(mesh, state_specs)
+    teacher_shardings = state_shardings.params
+    batch_sharding = NamedSharding(mesh, batch_pspec())
+    rng_sharding = NamedSharding(mesh, P())
+    dropout = _needs_dropout(cfg)
+    forward = _forward_fn(cfg, model, mesh, state_specs)
+    comm = make_comm_precision(cfg, mesh, state_specs.params)
+    update_fn = _make_update_fn(cfg, tx, mesh, state_specs, schedule)
+    anchor_logits = _make_logits_anchor(mesh)
+    alpha = cfg.distill_alpha
+    temp = cfg.distill_temp
+
+    def distill_step(state: TrainState, teacher, batch, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        images = prepare_images(batch["image"])
+        labels = batch["label"]
+        # teacher tower: eval-mode, grad-free — stop_gradient severs the
+        # (already-absent) path so no cotangent ever reaches teacher leaves.
+        # The comm cast applies to the teacher too: its FSDP gathers must
+        # move bf16 under the policy exactly like the student's (VTX-R003
+        # polices both towers in the one lowered program)
+        t_params = comm.cast(teacher) if comm is not None else teacher
+        t_logits = jax.lax.stop_gradient(
+            anchor_logits(forward(t_params, images, True)))
+        t_soft = jax.nn.softmax(t_logits.astype(jnp.float32) / temp, axis=-1)
+
+        def loss_fn(params):
+            p = comm.cast(params) if comm is not None else params
+            det = not dropout
+            r = step_rng if dropout else None
+            logits = anchor_logits(forward(p, images, det, rng=r))
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            s_log_soft = jax.nn.log_softmax(
+                logits.astype(jnp.float32) / temp, axis=-1)
+            # KL(teacher || student) up to the teacher-entropy constant,
+            # scaled by T^2; the constant is added back for the metric so
+            # the reported kl is a true divergence (>= 0, -> 0 at match)
+            kl = (temp * temp) * jnp.mean(jnp.sum(
+                t_soft * (jnp.log(t_soft + 1e-20) - s_log_soft), axis=-1))
+            loss = (1.0 - alpha) * ce + alpha * kl
+            return loss, (ce, kl, logits)
+
+        (loss, (ce, kl, s_logits)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        if comm is not None:
+            grads = comm.finalize_grads(grads)
+        new_params, new_opt_state, grad_norm = update_fn(
+            grads, state.opt_state, state.params)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state)
+        metrics = {
+            "loss": loss,
+            "ce": ce,
+            "kl": kl,
+            "grad_norm": grad_norm,
+            "lr_step": new_state.step,
+            "teacher_top1": jnp.mean(
+                (jnp.argmax(t_logits, axis=-1) == labels).astype(jnp.float32)),
+            "student_top1": jnp.mean(
+                (jnp.argmax(s_logits, axis=-1) == labels).astype(jnp.float32)),
+        }
+        return new_state, metrics
+
+    jitted = jax.jit(
+        distill_step,
+        in_shardings=(state_shardings, teacher_shardings, batch_sharding,
+                      rng_sharding),
+        out_shardings=(state_shardings, None),
+        # the student state is donated exactly like the train step's; the
+        # teacher is NOT — it is reused verbatim every step
+        donate_argnums=(0,) if donate else (),
+    )
+
+    images_per_step = cfg.batch_size
+    tokens_per_step = cfg.batch_size * cfg.num_patches
+
+    def step_with_teacher(state, batch, rng):
+        new_state, metrics = jitted(state, teacher_params, batch, rng)
+        metrics = dict(metrics, images=images_per_step,
+                       tokens=tokens_per_step)
+        return new_state, metrics
+
+    # AOT/jaxpr surfaces keep the loop's (state, batch, rng) signature and
+    # splice the teacher in — same shape as make_train_step's attachments
+    step_with_teacher.lower = lambda state, batch, rng: jitted.lower(
+        state, teacher_params, batch, rng)
+    step_with_teacher.trace = lambda state, batch, rng: jitted.trace(
+        state, teacher_params, batch, rng)
+    return step_with_teacher

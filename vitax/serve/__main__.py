@@ -29,13 +29,13 @@ def main(argv=None) -> int:
     ns = parser.parse_args(argv)
     cfg = Config(**config_fields_from_namespace(ns)).validate()
 
-    from vitax.serve.engine import InferenceEngine
+    # the registry's engine constructor (vitax/programs/builder.py):
+    # scenario-checked, then npz export or Orbax checkpoint exactly as the
+    # flags say — arbiter-provisioned replicas boot through the same path
+    from vitax.programs.builder import build_engine
     from vitax.serve.server import serve_forever
-    if ns.npz:
-        engine = InferenceEngine.from_npz(cfg, ns.npz)
-    else:
-        engine = InferenceEngine.from_checkpoint(
-            cfg, cfg.ckpt_dir, None if ns.epoch < 0 else ns.epoch)
+    engine = build_engine(cfg, npz=ns.npz,
+                          epoch=None if ns.epoch < 0 else ns.epoch)
     # serve_forever binds first, THEN warms: /healthz answers (live,
     # ready: false) while the AOT buckets compile, so a fleet router can
     # watch the replica warm without routing to it; SIGTERM drains cleanly

@@ -860,7 +860,7 @@ def _make_handler(router: Router):
                 return
             try:
                 out = hook(url)
-            except Exception as e:  # noqa: BLE001 # vtx: ignore[VTX106] surface hook failure to the arbiter, not a dead socket
+            except Exception as e:  # noqa: BLE001 # vtx: ignore[VTX106] hook failure -> arbiter, not a dead socket
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                 return
             self._reply(200, out if isinstance(out, dict) else {"ok": True})

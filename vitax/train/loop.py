@@ -27,8 +27,9 @@ from vitax.data import build_datasets
 from vitax.models import build_model, count_params
 from vitax.parallel.mesh import BATCH_AXES, build_mesh
 from vitax.train.control import ArbiterReporter, ControlPlane
-from vitax.train.state import TrainState, build_optimizer, make_train_state
-from vitax.train.step import make_eval_step, make_opt_probe, make_train_step
+from vitax.programs.builder import Geometry, build_program
+from vitax.programs.registry import get_scenario
+from vitax.train.state import TrainState, make_train_state
 from vitax.telemetry import (Watchdog, build_recorder,
                              install_thread_excepthook)
 from vitax.telemetry.watchdog import EXIT_HANG
@@ -174,7 +175,12 @@ def train(cfg: Config) -> TrainState:
                        or getattr(train_loader, "steps_per_epoch", 0)
                        or (len(train_ds) // cfg.batch_size))
     max_iteration = steps_per_epoch * cfg.num_epochs
-    tx, schedule = build_optimizer(cfg, max_iteration)
+    # the scenario registry (vitax/programs/registry.py) owns the optimizer
+    # assembly: --task train/distill get the reference AdamW chain verbatim,
+    # finetune appends the masked backbone-lr scale, probe masks the
+    # backbone frozen with head-only moments
+    scenario = get_scenario(cfg.task)
+    tx, schedule = scenario.make_optimizer(cfg, max_iteration)
     # On resume, build only the ABSTRACT state (no device materialization — the
     # checkpoint supplies the values; reference :246-248) and restore into it.
     state, state_specs, _ = make_train_state(
@@ -211,6 +217,14 @@ def train(cfg: Config) -> TrainState:
         else:  # an explicit --resume_epoch N must mean N — fail hard
             state = restore_state(cfg.ckpt_dir, cfg.resume_epoch, state)
             restore_info = {"path": "orbax", "epoch": cfg.resume_epoch}
+    if cfg.init_npz and cfg.resume_epoch <= 0:
+        # finetune/probe warm start: overwrite the fresh sharded init from
+        # the consolidated export (head re-init per --reinit_head / shape);
+        # an Orbax resume above takes precedence — the checkpoint already
+        # embodies the warm-started run
+        from vitax.programs.workloads import warm_start_from_npz
+        state, ft_info = warm_start_from_npz(cfg, state, mesh)
+        deferred_events.append(("finetune", ft_info))
     distributed.barrier("loaded model")
     master_print(f"\n=== model ===\n{model}\n")
     master_print(f"global parameter num: {count_params(state.params)}")
@@ -232,9 +246,16 @@ def train(cfg: Config) -> TrainState:
             f"grad accumulation: {cfg.grad_accum_steps} microbatches of "
             f"{cfg.batch_size // cfg.grad_accum_steps} inside the jitted "
             f"step (one optimizer step per loader batch)")
-    train_step = make_train_step(cfg, model, tx, mesh, state_specs,
-                                 schedule=schedule)
-    eval_step = make_eval_step(cfg, model, mesh, state_specs)
+    # one build_program(task, geometry) entry for every jitted program the
+    # loop runs (vitax/programs/builder.py). The geometry wraps the loop's
+    # LIVE objects (non-owned), so the built programs are the exact
+    # constructors' outputs — the lowered bytes are pinned identical to the
+    # former direct make_train_step/make_eval_step calls
+    # (tests/test_programs.py).
+    geom = Geometry(cfg=cfg, mesh=mesh, model=model, tx=tx,
+                    schedule=schedule, state_specs=state_specs)
+    train_step = build_program(scenario.step_program, geom)
+    eval_step = build_program("eval", geom)
 
     smoothed_loss = SmoothedValue(window_size=5)
     smoothed_time = SmoothedValue(window_size=5)
@@ -259,7 +280,7 @@ def train(cfg: Config) -> TrainState:
     # the recorder lives on rank 0 only, but the probe is a global-mesh
     # program — every process must execute it at the same log steps or
     # rank 0 blocks forever in a collective its peers never enter.
-    opt_probe = (make_opt_probe(cfg, tx, mesh, state_specs, schedule=schedule)
+    opt_probe = (build_program("opt_probe", geom)
                  if (getattr(cfg, "metrics_dir", "") or "") else None)
     opt_probe_warm = [False]
 
@@ -637,6 +658,19 @@ def _run_epochs(cfg, state, train_step, train_loader, val_loader, eval_step,
                                       if snap_pipe is not None else 0.0),
                         opt_update_s=opt_update_s,
                         grad_norm=float(jax.device_get(metrics["grad_norm"])))
+                    if "kl" in metrics:
+                        # distill step (vitax/programs/workloads.py): the
+                        # extra metrics ride the log-step fence the record
+                        # above just paid
+                        recorder.event(
+                            "distill", step=total_steps, epoch=epoch,
+                            kl=float(jax.device_get(metrics["kl"])),
+                            ce=float(jax.device_get(metrics["ce"])),
+                            teacher_top1=float(
+                                jax.device_get(metrics["teacher_top1"])),
+                            student_top1=float(
+                                jax.device_get(metrics["student_top1"])),
+                            alpha=cfg.distill_alpha, temp=cfg.distill_temp)
                 steps_since_record = 0
             if arbiter_reporter is not None:
                 # a lock + three assignments; the reporter thread posts
